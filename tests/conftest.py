@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE any jax
+usage so multi-device SPMD paths are exercised without TPU hardware
+(SURVEY.md §4 item 2).
+
+Note: this environment presets ``JAX_PLATFORMS=axon`` (a real-TPU tunnel) and
+the axon plugin wins platform selection over the env var, so the override
+must go through ``jax.config`` — setting the env var alone is NOT enough.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

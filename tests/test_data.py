@@ -1,0 +1,257 @@
+"""Data-layer tests: collation, masking, iterators, resume semantics
+(test strategy per SURVEY.md §4 — the reference has none of these)."""
+
+import numpy as np
+import pytest
+
+from unicore_tpu.data import (
+    AppendTokenDataset,
+    Dictionary,
+    EpochBatchIterator,
+    EpochShuffleDataset,
+    MaskTokensDataset,
+    NestedDictionaryDataset,
+    NumSamplesDataset,
+    NumelDataset,
+    PrependTokenDataset,
+    RawLabelDataset,
+    RightPadDataset,
+    SortDataset,
+    TokenizeDataset,
+    data_utils,
+)
+from unicore_tpu.data.indexed_dataset import IndexedPickleDataset, make_builder
+from unicore_tpu.data.unicore_dataset import UnicoreDataset
+
+
+class ListDataset(UnicoreDataset):
+    def __init__(self, items):
+        self.items = items
+
+    def __getitem__(self, idx):
+        return self.items[idx]
+
+    def __len__(self):
+        return len(self.items)
+
+    def collater(self, samples):
+        return np.stack(samples)
+
+
+def make_dictionary():
+    d = Dictionary()
+    for s in ["[CLS]", "[PAD]", "[SEP]", "[UNK]"]:
+        d.add_symbol(s, is_special=True)
+    for s in "abcdefghij":
+        d.add_symbol(s)
+    d.add_symbol("[MASK]", is_special=True)
+    return d
+
+
+def test_collate_tokens_pads_to_multiple():
+    vals = [np.arange(5), np.arange(3)]
+    out = data_utils.collate_tokens(vals, pad_idx=0, pad_to_multiple=8)
+    assert out.shape == (2, 8)
+    assert (out[0, :5] == np.arange(5)).all()
+    assert (out[1, 3:] == 0).all()
+
+
+def test_collate_tokens_left_pad():
+    vals = [np.arange(1, 4)]
+    out = data_utils.collate_tokens(vals, pad_idx=9, left_pad=True, pad_to_multiple=1)
+    assert out.tolist() == [[1, 2, 3]]
+    out = data_utils.collate_tokens(
+        [np.arange(1, 4), np.arange(1, 2)], pad_idx=9, left_pad=True
+    )
+    assert out[1].tolist() == [9, 9, 1]
+
+
+def test_collate_tokens_2d_square():
+    vals = [np.ones((3, 3)), np.ones((2, 2))]
+    out = data_utils.collate_tokens_2d(vals, pad_idx=0, pad_to_multiple=1)
+    assert out.shape == (2, 3, 3)
+    assert out[1, :2, :2].sum() == 4
+    assert out[1, 2, :].sum() == 0
+
+
+def test_batch_by_size_multiple():
+    idx = np.arange(10)
+    batches = data_utils.batch_by_size(idx, batch_size=4, required_batch_size_multiple=2)
+    assert [len(b) for b in batches] == [4, 4, 2]
+
+
+def test_numpy_seed_restores_state():
+    np.random.seed(123)
+    before = np.random.get_state()[1][:5].copy()
+    with data_utils.numpy_seed(7):
+        _ = np.random.rand(3)
+    after = np.random.get_state()[1][:5]
+    assert (before == after).all()
+
+
+def test_mask_tokens_dataset_determinism_and_targets():
+    d = make_dictionary()
+    rng = np.random.RandomState(0)
+    items = [
+        np.concatenate([[d.bos()], rng.randint(4, 14, size=20), [d.eos()]])
+        for _ in range(8)
+    ]
+    base = ListDataset(items)
+    src, tgt = MaskTokensDataset.apply_mask(
+        base,
+        vocab=d,
+        pad_idx=d.pad(),
+        mask_idx=d.index("[MASK]"),
+        seed=13,
+    )
+    src.set_epoch(1)
+    tgt.set_epoch(1)
+    a1, t1 = src[0], tgt[0]
+    a2, t2 = src[0], tgt[0]
+    assert (a1 == a2).all() and (t1 == t2).all()
+    # first/last positions never masked
+    assert a1[0] == items[0][0] and a1[-1] == items[0][-1]
+    # target holds original token at corrupted positions, pad elsewhere
+    masked_pos = t1 != d.pad()
+    assert (t1[masked_pos] == items[0][masked_pos]).all()
+    # different epoch -> different mask (with overwhelming probability)
+    src.set_epoch(2)
+    tgt.set_epoch(2)
+    assert not (src[0] == a1).all() or not (tgt[0] == t1).all()
+
+
+def test_nested_dictionary_dataset_roundtrip():
+    base = ListDataset([np.arange(4) + i for i in range(6)])
+    ds = NestedDictionaryDataset(
+        {
+            "net_input": {"src_tokens": RightPadDataset(base, pad_idx=0)},
+            "target": RightPadDataset(base, pad_idx=0),
+            "nsamples": NumSamplesDataset(),
+            "ntokens": NumelDataset(base, reduce=True),
+        }
+    )
+    sample = ds.collater([ds[0], ds[1]])
+    assert sample["net_input"]["src_tokens"].shape[0] == 2
+    assert sample["nsamples"] == 2
+    assert sample["ntokens"] == 8
+
+
+def test_sort_and_shuffle_datasets():
+    base = ListDataset([np.zeros(i + 1) for i in range(10)])
+    sizes = np.array([len(base[i]) for i in range(10)])
+    sd = SortDataset(base, sort_order=[-sizes])
+    order = sd.ordered_indices()
+    assert list(order) == list(np.argsort(-sizes, kind="stable"))
+
+    es = EpochShuffleDataset(base, size=10, seed=3)
+    o1 = es.ordered_indices().copy()
+    es.set_epoch(2)
+    o2 = es.ordered_indices()
+    assert sorted(o1) == list(range(10))
+    assert not (o1 == o2).all()
+    assert not es.can_reuse_epoch_itr_across_epochs
+
+
+def test_append_prepend_token():
+    base = ListDataset([np.array([5, 6])])
+    assert AppendTokenDataset(base, token=9)[0].tolist() == [5, 6, 9]
+    assert PrependTokenDataset(base, token=2)[0].tolist() == [2, 5, 6]
+
+
+def test_tokenize_dataset():
+    d = make_dictionary()
+    base = ListDataset([np.array(list("abc"))])
+    td = TokenizeDataset(base, d, max_seq_len=16)
+    assert td[0].tolist() == [d.index("a"), d.index("b"), d.index("c")]
+
+
+def test_indexed_pickle_dataset_roundtrip(tmp_path):
+    path = str(tmp_path / "shard")
+    builder = make_builder(path)
+    objs = [{"x": np.arange(i + 1)} for i in range(5)]
+    for o in objs:
+        builder.add_item(o)
+    builder.finalize()
+    ds = IndexedPickleDataset(path)
+    assert len(ds) == 5
+    for i, o in enumerate(objs):
+        assert (ds[i]["x"] == o["x"]).all()
+
+
+def _make_epoch_iter(n=12, batch=2, seed=1, num_shards=1, shard_id=0):
+    base = ListDataset([np.full(4, i) for i in range(n)])
+    sampler = data_utils.batch_by_size(np.arange(n), batch_size=batch)
+    return EpochBatchIterator(
+        dataset=base,
+        collate_fn=base.collater,
+        batch_sampler=sampler,
+        seed=seed,
+        num_shards=num_shards,
+        shard_id=shard_id,
+    )
+
+
+def test_epoch_batch_iterator_basic():
+    it = _make_epoch_iter()
+    epoch_itr = it.next_epoch_itr(shuffle=False)
+    batches = list(epoch_itr)
+    assert len(batches) == 6
+    assert it.end_of_epoch()
+    assert it.next_epoch_idx == 2
+
+
+def test_epoch_batch_iterator_shuffle_deterministic():
+    it1 = _make_epoch_iter(seed=5)
+    it2 = _make_epoch_iter(seed=5)
+    b1 = [b[:, 0].tolist() for b in it1.next_epoch_itr(shuffle=True)]
+    b2 = [b[:, 0].tolist() for b in it2.next_epoch_itr(shuffle=True)]
+    assert b1 == b2
+
+
+def test_epoch_batch_iterator_resume_mid_epoch():
+    it = _make_epoch_iter()
+    epoch_itr = it.next_epoch_itr(shuffle=True)
+    consumed = [next(epoch_itr), next(epoch_itr)]
+    state = it.state_dict()
+    assert state["iterations_in_epoch"] == 2
+
+    it2 = _make_epoch_iter()
+    it2.load_state_dict(state)
+    resumed = it2.next_epoch_itr(shuffle=True)
+    rest = list(resumed)
+    assert len(consumed) + len(rest) == 6
+    # the resumed batches must be the not-yet-consumed ones, in order
+    fresh = list(_make_epoch_iter().next_epoch_itr(shuffle=True))
+    assert [b.tolist() for b in rest] == [b.tolist() for b in fresh[2:]]
+
+
+def test_epoch_batch_iterator_resume_rescale_on_len_change():
+    it = _make_epoch_iter(n=12, batch=2)  # 6 batches
+    epoch_itr = it.next_epoch_itr(shuffle=False)
+    next(epoch_itr)
+    next(epoch_itr)
+    next(epoch_itr)  # consumed 3/6
+    state = it.state_dict()
+    # resume with 2 shards -> len 3; position should rescale 3 -> 1 (floor 3*3/6)
+    it2 = _make_epoch_iter(n=12, batch=2, num_shards=2, shard_id=0)
+    it2.load_state_dict(state)
+    assert it2.iterations_in_epoch == 1
+
+
+def test_sharded_iteration_covers_all():
+    seen = []
+    for shard in range(3):
+        it = _make_epoch_iter(n=12, batch=2, num_shards=3, shard_id=shard)
+        for b in it.next_epoch_itr(shuffle=False):
+            if len(b):
+                seen.extend(b[:, 0].tolist())
+    assert sorted(seen) == list(range(12))
+
+
+def test_grouped_iterator():
+    from unicore_tpu.data import GroupedIterator
+
+    it = _make_epoch_iter(n=12, batch=2)
+    g = GroupedIterator(it.next_epoch_itr(shuffle=False), 4)
+    groups = list(g)
+    assert [len(x) for x in groups] == [4, 2]

@@ -1,0 +1,268 @@
+"""Optimizer + LR scheduler + mixed-precision tests."""
+
+import argparse
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.optim import OPTIMIZER_REGISTRY, build_optimizer
+from unicore_tpu.optim.lr_scheduler import LR_SCHEDULER_REGISTRY, build_lr_scheduler
+from unicore_tpu.optim.unicore_optimizer import make_decay_mask
+from unicore_tpu.ops.rounding import fp32_to_bf16_sr
+from unicore_tpu.registry import set_defaults
+
+
+def make_args(**kw):
+    args = argparse.Namespace()
+    defaults = dict(
+        optimizer="adam",
+        lr=[1e-2],
+        adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8,
+        weight_decay=0.0,
+        bf16_sr=False,
+    )
+    defaults.update(kw)
+    for k, v in defaults.items():
+        setattr(args, k, v)
+    return args
+
+
+def make_params(dtype=jnp.float32):
+    return {
+        "dense": {
+            "kernel": jnp.ones((4, 4), dtype) * 0.5,
+            "bias": jnp.zeros((4,), dtype),
+        }
+    }
+
+
+def test_adam_converges_quadratic():
+    args = make_args()
+    opt = OPTIMIZER_REGISTRY["adam"](args)
+    params = {"w": jnp.asarray([5.0, -3.0])}
+    state = opt.init_state(params)
+
+    def loss_fn(p):
+        return jnp.sum(jnp.square(p["w"] - jnp.asarray([1.0, 2.0])))
+
+    for _ in range(500):
+        grads = jax.grad(loss_fn)(params)
+        params, state = opt.update(grads, state, params, lr=0.05)
+    np.testing.assert_allclose(np.asarray(params["w"]), [1.0, 2.0], atol=1e-2)
+
+
+def test_adam_matches_torch_adam():
+    # wd=0: the reference kernel's eps placement (raw sqrt(v)+eps, bias
+    # correction folded into step_size) matches torch Adam to ~eps-level
+    torch = pytest.importorskip("torch")
+    args = make_args(weight_decay=0.0)
+    opt = OPTIMIZER_REGISTRY["adam"](args)
+    w0 = np.random.RandomState(0).randn(6, 3).astype(np.float32)
+    params = {"layer": {"kernel": jnp.asarray(w0)}}
+    state = opt.init_state(params)
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.Adam([tw], lr=1e-2, betas=(0.9, 0.999), eps=1e-8)
+    rng = np.random.RandomState(1)
+    for _ in range(10):
+        g = rng.randn(6, 3).astype(np.float32)
+        params, state = opt.update(
+            {"layer": {"kernel": jnp.asarray(g)}}, state, params, lr=1e-2
+        )
+        tw.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(
+        np.asarray(params["layer"]["kernel"]), tw.detach().numpy(), atol=1e-4
+    )
+
+
+def test_adam_weight_decay_reference_semantics():
+    # decoupled decay applied BEFORE the update, scaled by the bias-corrected
+    # step size (reference adam_kernel.cu:39, host :77-80)
+    args = make_args(weight_decay=0.5)
+    opt = OPTIMIZER_REGISTRY["adam"](args)
+    params = {"layer": {"kernel": jnp.full((2, 2), 2.0)}}
+    state = opt.init_state(params)
+    g = {"layer": {"kernel": jnp.zeros((2, 2))}}
+    lr = 0.1
+    new_params, _ = opt.update(g, state, params, lr=lr)
+    bc1, bc2 = 1 - 0.9, 1 - 0.999
+    step_size = lr * (bc2 ** 0.5) / bc1
+    np.testing.assert_allclose(
+        np.asarray(new_params["layer"]["kernel"]),
+        2.0 * (1 - step_size * 0.5),
+        rtol=1e-6,
+    )
+
+
+def test_decay_mask_excludes_bias_and_norms():
+    params = {
+        "dense": {"kernel": jnp.ones((3, 3)), "bias": jnp.ones((3,))},
+        "layer_norm": {"weight": jnp.ones((8, 8))},
+    }
+    mask = make_decay_mask(params)
+    assert mask["dense"]["kernel"] is True
+    assert mask["dense"]["bias"] is False
+    assert mask["layer_norm"]["weight"] is False
+
+
+def test_bf16_master_params_and_sr():
+    args = make_args(bf16_sr=True)
+    opt = OPTIMIZER_REGISTRY["adam"](args)
+    params = make_params(jnp.bfloat16)
+    state = opt.init_state(params)
+    assert state["master"] is not None
+    assert state["master"]["dense"]["kernel"].dtype == jnp.float32
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    new_params, new_state = opt.update(
+        grads, state, params, lr=1e-3, sr_rng=jax.random.PRNGKey(0)
+    )
+    assert new_params["dense"]["kernel"].dtype == jnp.bfloat16
+    # master moved by ~lr in fp32
+    assert float(new_state["master"]["dense"]["kernel"][0, 0]) < 0.5
+
+
+def test_skip_update_is_noop():
+    args = make_args()
+    opt = OPTIMIZER_REGISTRY["adam"](args)
+    params = make_params()
+    state = opt.init_state(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    new_params, new_state = opt.update(
+        grads, state, params, lr=1e-2, skip_update=jnp.asarray(True)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(new_params["dense"]["kernel"]),
+        np.asarray(params["dense"]["kernel"]),
+    )
+    assert int(new_state["step"]) == 0
+
+
+def test_sgd_momentum_and_adagrad_and_adadelta_run():
+    for name, extra in [
+        ("sgd", dict(momentum=0.9)),
+        ("adagrad", {}),
+        ("adadelta", {}),
+    ]:
+        args = make_args(optimizer=name, **extra)
+        cls = OPTIMIZER_REGISTRY[name]
+        set_defaults(args, cls)
+        opt = cls(args)
+        params = {"w": jnp.asarray([1.0, 2.0])}
+        state = opt.init_state(params)
+        grads = {"w": jnp.asarray([0.1, 0.1])}
+        p2, _ = opt.update(grads, state, params, lr=0.1)
+        assert not np.allclose(np.asarray(p2["w"]), np.asarray(params["w"]))
+
+
+def test_fp32_to_bf16_sr_unbiased():
+    # a value exactly between two bf16 representables should round both ways
+    x = jnp.full((10000,), 1.0 + 2 ** -9, dtype=jnp.float32)
+    out = fp32_to_bf16_sr(x, jax.random.PRNGKey(42)).astype(jnp.float32)
+    mean = float(jnp.mean(out))
+    np.testing.assert_allclose(mean, 1.0 + 2 ** -9, rtol=2e-4)
+    assert len(np.unique(np.asarray(out))) == 2
+
+
+def _sched_args(name, **kw):
+    cls = LR_SCHEDULER_REGISTRY[name]
+    args = argparse.Namespace(lr=[1.0], lr_scheduler=name, **kw)
+    set_defaults(args, cls)
+    return args, cls
+
+
+def test_polynomial_decay_schedule():
+    args, cls = _sched_args(
+        "polynomial_decay", warmup_updates=10, total_num_update=110,
+        warmup_ratio=-1.0, force_anneal=None,
+    )
+    sched = cls(args, None, None)
+    assert abs(sched.step_update(5) - 0.5) < 1e-9
+    assert abs(sched.step_update(10) - 1.0) < 1e-9
+    assert abs(sched.step_update(60) - 0.5) < 1e-9
+    assert sched.step_update(110) == 0.0
+
+
+def test_warmup_ratio_uses_total_steps():
+    args, cls = _sched_args(
+        "polynomial_decay", warmup_ratio=0.1, force_anneal=None,
+    )
+    sched = cls(args, None, total_train_steps=1000)
+    assert sched.warmup_updates == 100
+    assert sched.total_num_update == 1000
+
+
+def test_inverse_sqrt_schedule():
+    args, cls = _sched_args("inverse_sqrt", warmup_updates=100)
+    sched = cls(args, None, None)
+    sched.step_update(50)
+    assert abs(sched.get_lr() - 0.5) < 1e-9
+    sched.step_update(400)
+    assert abs(sched.get_lr() - 1.0 * (100 ** 0.5) * (400 ** -0.5)) < 1e-9
+
+
+def test_cosine_schedule_endpoints():
+    args, cls = _sched_args(
+        "cosine", warmup_updates=0, warmup_ratio=-1.0, min_lr=0.1,
+    )
+    sched = cls(args, None, total_train_steps=100)
+    lr0 = sched.step_update(0)
+    lr_mid = sched.step_update(50)
+    lr_end = sched.step_update(100)
+    assert abs(lr0 - 1.0) < 1e-9
+    assert abs(lr_mid - 0.55) < 1e-9
+    assert abs(lr_end - 0.1) < 1e-9
+
+
+def test_exponential_decay_schedule():
+    args, cls = _sched_args("exponential_decay", warmup_updates=0,
+                            decay_ratio=0.5, decay_steps=10)
+    sched = cls(args, None, None)
+    assert abs(sched.step_update(10) - 0.5) < 1e-9
+
+
+def test_tri_stage_schedule():
+    args, cls = _sched_args(
+        "tri_stage", warmup_steps=10, hold_steps=10, decay_steps=10,
+        init_lr_scale=0.01, final_lr_scale=0.01, phase_ratio=None,
+    )
+    sched = cls(args, None, None)
+    assert abs(sched.step_update(0) - 0.01) < 1e-9
+    assert abs(sched.step_update(15) - 1.0) < 1e-9
+    assert abs(sched.step_update(100) - 0.01) < 1e-9
+
+
+def test_reduce_on_plateau():
+    args, cls = _sched_args(
+        "reduce_lr_on_plateau", lr_patience=0, lr_shrink=0.5,
+        lr_threshold=1e-4, warmup_updates=0, warmup_init_lr=-1,
+        maximize_best_checkpoint_metric=False,
+    )
+    sched = cls(args, None, None)
+    sched.step(1, val_loss=1.0)
+    assert sched.get_lr() == 1.0
+    sched.step(2, val_loss=1.0)  # no improvement -> shrink
+    assert sched.get_lr() == 0.5
+
+
+def test_fixed_schedule_warmup():
+    args, cls = _sched_args("fixed", warmup_updates=4, force_anneal=None)
+    sched = cls(args, None, None)
+    sched.step_begin_epoch(1)
+    assert abs(sched.step_update(0) - 0.25) < 1e-9
+    assert abs(sched.step_update(100) - 1.0) < 1e-9
+
+
+def test_dynamic_loss_scaler_jit_side():
+    from unicore_tpu.optim.dynamic_loss_scaler import update_scale
+
+    scale, since = jnp.asarray(128.0), jnp.asarray(0)
+    scale, since = update_scale(scale, since, jnp.asarray(True), scale_window=4)
+    assert float(scale) == 64.0 and int(since) == 0
+    for i in range(4):
+        scale, since = update_scale(scale, since, jnp.asarray(False), scale_window=4)
+    assert float(scale) == 128.0

@@ -1,0 +1,19 @@
+"""unicore_tpu — a TPU-native training framework with the capability surface
+of Uni-Core (reference /root/reference), built from scratch on
+JAX/XLA/Pallas/pjit.
+"""
+
+__version__ = "0.0.1"
+__all__ = ["pdb"]
+
+import unicore_tpu.utils  # noqa
+from unicore_tpu.distributed import utils as distributed_utils  # noqa
+from unicore_tpu.logging import meters, metrics, progress_bar  # noqa
+
+import unicore_tpu.data  # noqa
+import unicore_tpu.losses  # noqa
+import unicore_tpu.models  # noqa
+import unicore_tpu.modules  # noqa
+import unicore_tpu.optim  # noqa
+import unicore_tpu.optim.lr_scheduler  # noqa
+import unicore_tpu.tasks  # noqa

@@ -1,0 +1,35 @@
+"""WordPiece tokenization view (reference /root/reference/unicore/data/bert_tokenize_dataset.py:12)."""
+
+import numpy as np
+
+from .base_wrapper_dataset import BaseWrapperDataset
+
+try:
+    from tokenizers import BertWordPieceTokenizer
+
+    _HAS_TOKENIZERS = True
+except ImportError:
+    BertWordPieceTokenizer = None
+    _HAS_TOKENIZERS = False
+
+
+class BertTokenizeDataset(BaseWrapperDataset):
+    def __init__(self, dataset, dict_path: str, max_seq_len: int = 512):
+        if not _HAS_TOKENIZERS:
+            raise ImportError("BertTokenizeDataset requires the 'tokenizers' package")
+        self.dataset = dataset
+        self.tokenizer = BertWordPieceTokenizer(dict_path, lowercase=True)
+        self.max_seq_len = max_seq_len
+
+    @property
+    def can_reuse_epoch_itr_across_epochs(self):
+        return True  # only the noise changes, not item sizes
+
+    def __getitem__(self, index: int):
+        raw_str = self.dataset[index]
+        raw_str = raw_str.replace("<unk>", "[UNK]")
+        output = self.tokenizer.encode(raw_str)
+        ret = np.asarray(output.ids, dtype=np.int64)
+        if ret.shape[0] > self.max_seq_len:
+            ret = ret[: self.max_seq_len]
+        return ret

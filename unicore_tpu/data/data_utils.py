@@ -1,0 +1,131 @@
+"""Collation and seeding utilities
+(reference /root/reference/unicore/data/data_utils.py:17-139).
+
+Pure numpy — batches are assembled on host and transferred to device once per
+step (sharded across the mesh by the trainer), so collation never touches JAX.
+"""
+
+import contextlib
+import logging
+import threading
+from typing import Iterable, List
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# numpy's global RNG is process-wide state; loader threads entering seeded
+# sections concurrently would corrupt each other's streams (the reference is
+# safe only because its DataLoader workers are separate processes).  All
+# numpy_seed sections serialize on this lock — collation, the heavy part,
+# stays parallel.
+_np_seed_lock = threading.RLock()
+
+
+def collate_tokens(
+    values: List[np.ndarray],
+    pad_idx,
+    left_pad=False,
+    pad_to_length=None,
+    pad_to_multiple=1,
+):
+    """Convert a list of 1d arrays into a padded 2d array
+    (reference data_utils.py:17-37)."""
+    values = [np.asarray(v) for v in values]
+    size = max(v.shape[0] for v in values)
+    size = size if pad_to_length is None else max(size, pad_to_length)
+    if pad_to_multiple != 1 and size % pad_to_multiple != 0:
+        size = int(((size - 0.1) // pad_to_multiple + 1) * pad_to_multiple)
+    res = np.full((len(values), size), pad_idx, dtype=values[0].dtype)
+    for i, v in enumerate(values):
+        if left_pad:
+            res[i, size - len(v):] = v
+        else:
+            res[i, : len(v)] = v
+    return res
+
+
+def collate_tokens_2d(
+    values: List[np.ndarray],
+    pad_idx,
+    left_pad=False,
+    pad_to_length=None,
+    pad_to_multiple=1,
+):
+    """Convert a list of 2d (L x L) arrays into a padded square 3d array —
+    pairwise features for Uni-Mol/Uni-Fold (reference data_utils.py:40-60)."""
+    values = [np.asarray(v) for v in values]
+    size = max(v.shape[0] for v in values)
+    size = size if pad_to_length is None else max(size, pad_to_length)
+    if pad_to_multiple != 1 and size % pad_to_multiple != 0:
+        size = int(((size - 0.1) // pad_to_multiple + 1) * pad_to_multiple)
+    res = np.full(
+        (len(values), size, size) + values[0].shape[2:], pad_idx, dtype=values[0].dtype
+    )
+    for i, v in enumerate(values):
+        if left_pad:
+            res[i, size - v.shape[0]:, size - v.shape[1]:] = v
+        else:
+            res[i, : v.shape[0], : v.shape[1]] = v
+    return res
+
+
+def collate_dict(
+    values: List[dict],
+    dim=0,
+):
+    """Stack a list of dicts of arrays along ``dim``
+    (reference data_utils.py:63-73)."""
+    if len(values) == 0:
+        return {}
+    return {
+        key: np.stack([v[key] for v in values], axis=dim) for key in values[0].keys()
+    }
+
+
+@contextlib.contextmanager
+def numpy_seed(seed, *addl_seeds):
+    """Context manager which seeds the numpy PRNG and restores state after
+    (reference data_utils.py:83-104)."""
+    if seed is None:
+        yield
+        return
+    if len(addl_seeds) > 0:
+        seed = int(hash((seed, *addl_seeds)) % 1e6)
+    with _np_seed_lock:
+        state = np.random.get_state()
+        np.random.seed(seed)
+        try:
+            yield
+        finally:
+            np.random.set_state(state)
+
+
+def batch_by_size(
+    indices,
+    batch_size=None,
+    required_batch_size_multiple=1,
+):
+    """Chunk ordered indices into fixed-size batches, honoring
+    ``required_batch_size_multiple`` (reference data_utils.py:107-139).
+
+    TPU note: fixed batch sizes keep jit shapes static — one compile."""
+    batch_size = batch_size if batch_size is not None else 1
+    bsz_mult = required_batch_size_multiple
+
+    step = ((batch_size + bsz_mult - 1) // bsz_mult) * bsz_mult
+
+    if not isinstance(indices, np.ndarray):
+        indices = np.fromiter(indices, dtype=np.int64, count=-1)
+
+    num_batches = (len(indices) + step - 1) // step
+    steps = np.arange(num_batches - 1) + 1
+    steps *= step
+    batch_indices = np.split(indices, steps)
+    assert len(batch_indices) == num_batches
+    # validation, can be removed
+    assert all(len(b) <= step for b in batch_indices)
+    assert len(batch_indices) <= 1 or all(
+        len(b) == step for b in batch_indices[:-1]
+    )
+    return batch_indices

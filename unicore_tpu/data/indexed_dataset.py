@@ -1,0 +1,83 @@
+"""Native mmap-indexed pickle shard format.
+
+This framework's own storage backend — fills the role LMDB plays in the
+reference (/root/reference/unicore/data/lmdb_dataset.py) on machines without
+the lmdb package, and serves as the target for the C++ fast reader in
+``csrc/``.  Layout:
+
+    <path>.bin   concatenated pickled (or raw-bytes) records
+    <path>.idx   header | uint64 offsets[n+1]
+
+Reads are zero-copy mmap slices; no page-cache readahead thrash for random
+access patterns (the reason the reference disables readahead on LMDB).
+"""
+
+import os
+import pickle
+import struct
+from typing import Any, List
+
+import numpy as np
+
+from .unicore_dataset import UnicoreDataset
+
+_MAGIC = b"UCTPIDX1"
+
+
+class IndexedPickleDatasetBuilder:
+    def __init__(self, path: str):
+        self.path = path
+        self._data_f = open(path + ".bin", "wb")
+        self._offsets: List[int] = [0]
+
+    def add_item(self, obj: Any):
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self._data_f.write(payload)
+        self._offsets.append(self._offsets[-1] + len(payload))
+
+    def finalize(self):
+        self._data_f.close()
+        with open(self.path + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<Q", len(self._offsets) - 1))
+            f.write(np.asarray(self._offsets, dtype=np.uint64).tobytes())
+
+
+def make_builder(path: str) -> IndexedPickleDatasetBuilder:
+    return IndexedPickleDatasetBuilder(path)
+
+
+class IndexedPickleDataset(UnicoreDataset):
+    """Random-access reader over the native shard format."""
+
+    def __init__(self, path: str):
+        idx_path = path + ".idx"
+        bin_path = path + ".bin"
+        assert os.path.isfile(idx_path), f"{idx_path} not found"
+        assert os.path.isfile(bin_path), f"{bin_path} not found"
+        with open(idx_path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            assert magic == _MAGIC, f"bad index file magic in {idx_path}"
+            (n,) = struct.unpack("<Q", f.read(8))
+            self._offsets = np.frombuffer(f.read(8 * (n + 1)), dtype=np.uint64)
+        self._path = bin_path
+        self._mmap = None
+        self._n = int(n)
+
+    def _ensure_open(self):
+        if self._mmap is None:
+            # lazy per-process open (fork-safe, like the reference's lazy LMDB env)
+            self._mmap = np.memmap(self._path, dtype=np.uint8, mode="r")
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, idx):
+        self._ensure_open()
+        lo, hi = int(self._offsets[idx]), int(self._offsets[idx + 1])
+        return pickle.loads(self._mmap[lo:hi].tobytes())
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_mmap"] = None
+        return state

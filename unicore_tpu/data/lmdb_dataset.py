@@ -1,0 +1,64 @@
+"""LMDB-backed dataset (reference /root/reference/unicore/data/lmdb_dataset.py:16-49).
+
+Pickled values keyed by stringified index, lazy per-process env open.  Gated on
+the ``lmdb`` package; environments without it can use
+:class:`unicore_tpu.data.indexed_dataset.IndexedPickleDataset`, this
+framework's native mmap shard format, which needs no third-party reader.
+"""
+
+import logging
+import os
+import pickle
+
+from .unicore_dataset import UnicoreDataset
+
+logger = logging.getLogger(__name__)
+
+try:
+    import lmdb
+
+    _HAS_LMDB = True
+except ImportError:
+    lmdb = None
+    _HAS_LMDB = False
+
+
+class LMDBDataset(UnicoreDataset):
+    def __init__(self, db_path):
+        if not _HAS_LMDB:
+            raise ImportError(
+                "LMDBDataset requires the 'lmdb' package; alternatively convert "
+                "your data with unicore_tpu.data.indexed_dataset.make_builder()."
+            )
+        self.db_path = db_path
+        assert os.path.isfile(db_path), f"{db_path} not found"
+        env = self.connect_db(self.db_path)
+        with env.begin() as txn:
+            self._keys = list(txn.cursor().iternext(values=False))
+        env.close()
+        self._env = None
+
+    def connect_db(self, lmdb_path, save_to_self=False):
+        env = lmdb.open(
+            lmdb_path,
+            subdir=False,
+            readonly=True,
+            lock=False,
+            readahead=False,
+            meminit=False,
+            max_readers=256,
+        )
+        if not save_to_self:
+            return env
+        else:
+            self._env = env
+
+    def __len__(self):
+        return len(self._keys)
+
+    def __getitem__(self, idx):
+        # lazy open per worker process/thread
+        if self._env is None:
+            self.connect_db(self.db_path, save_to_self=True)
+        datapoint_pickled = self._env.begin().get(self._keys[idx])
+        return pickle.loads(datapoint_pickled)

@@ -1,0 +1,37 @@
+"""LRU-cached dataset view (reference /root/reference/unicore/data/lru_cache_dataset.py).
+
+Epoch-aware: the cache drops on ``set_epoch`` so epoch-seeded upstream
+datasets (masking, shuffling) are re-evaluated.  The reference gets this for
+free by recreating DataLoader worker processes per epoch; here workers are
+threads in one process, so the cache must be invalidated explicitly.
+"""
+
+import threading
+from collections import OrderedDict
+
+from .base_wrapper_dataset import BaseWrapperDataset
+
+
+class LRUCacheDataset(BaseWrapperDataset):
+    def __init__(self, dataset, token=None, maxsize=16):
+        super().__init__(dataset)
+        self._maxsize = maxsize
+        self._cache = OrderedDict()
+        self._lock = threading.Lock()  # loader threads share this view
+
+    def __getitem__(self, index):
+        with self._lock:
+            if index in self._cache:
+                self._cache.move_to_end(index)
+                return self._cache[index]
+        value = self.dataset[index]
+        with self._lock:
+            self._cache[index] = value
+            if len(self._cache) > self._maxsize:
+                self._cache.popitem(last=False)
+        return value
+
+    def set_epoch(self, epoch):
+        super().set_epoch(epoch)
+        with self._lock:
+            self._cache.clear()

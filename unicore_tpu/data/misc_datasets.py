@@ -1,0 +1,163 @@
+"""Small dataset views, numpy-native.
+
+Covers the reference's numel_dataset.py, num_samples_dataset.py,
+raw_dataset.py, from_numpy_dataset.py, append_token_dataset.py,
+prepend_token_dataset.py and tokenize_dataset.py
+(/root/reference/unicore/data/*).
+"""
+
+from functools import lru_cache
+
+import numpy as np
+
+from .base_wrapper_dataset import BaseWrapperDataset
+from .dictionary import Dictionary
+from .unicore_dataset import UnicoreDataset
+
+
+def default_collate(samples):
+    """Stack/convert a list of samples (replaces torch default_collate)."""
+    first = samples[0]
+    if isinstance(first, np.ndarray):
+        return np.stack(samples)
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (list, tuple)):
+        return type(first)(default_collate(list(col)) for col in zip(*samples))
+    return np.asarray(samples)
+
+
+class NumelDataset(BaseWrapperDataset):
+    """Per-sample element count (reference numel_dataset.py)."""
+
+    def __init__(self, dataset, reduce=False):
+        super().__init__(dataset)
+        self.reduce = reduce
+
+    def __getitem__(self, index):
+        return np.size(self.dataset[index])
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def collater(self, samples):
+        if self.reduce:
+            return sum(samples)
+        else:
+            return np.asarray(samples)
+
+
+class NumSamplesDataset(UnicoreDataset):
+    """Constant-1 view whose collater counts samples (reference num_samples_dataset.py)."""
+
+    def __getitem__(self, index):
+        return 1
+
+    def __len__(self):
+        return 0
+
+    def collater(self, samples):
+        return sum(samples)
+
+
+class RawLabelDataset(UnicoreDataset):
+    def __init__(self, labels):
+        super().__init__()
+        self.labels = labels
+
+    def __getitem__(self, index):
+        return self.labels[index]
+
+    def __len__(self):
+        return len(self.labels)
+
+    def collater(self, samples):
+        return np.asarray(samples)
+
+
+class RawArrayDataset(UnicoreDataset):
+    def __init__(self, dataset):
+        super().__init__()
+        self.dataset = dataset
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, index):
+        return self.dataset[index]
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def collater(self, samples):
+        if hasattr(self.dataset, "collater"):
+            return self.dataset.collater(samples)
+        else:
+            return default_collate(samples)
+
+
+class RawNumpyDataset(UnicoreDataset):
+    def __init__(self, dataset):
+        super().__init__()
+        self.dataset = dataset
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, index):
+        return np.asarray(self.dataset[index])
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def collater(self, samples):
+        if hasattr(self.dataset, "collater"):
+            return self.dataset.collater(samples)
+        else:
+            return default_collate(samples)
+
+
+class FromNumpyDataset(BaseWrapperDataset):
+    """Identity view kept for API parity (reference from_numpy_dataset.py —
+    its torch conversion has no TPU analogue; host samples stay numpy)."""
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, idx):
+        return np.asarray(self.dataset[idx])
+
+
+class AppendTokenDataset(BaseWrapperDataset):
+    def __init__(self, dataset, token=None):
+        super().__init__(dataset)
+        self.token = token
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, idx):
+        item = np.asarray(self.dataset[idx])
+        if self.token is not None:
+            item = np.concatenate([item, np.full_like(item[:1], self.token)], axis=0)
+        return item
+
+
+class PrependTokenDataset(BaseWrapperDataset):
+    def __init__(self, dataset, token=None):
+        super().__init__(dataset)
+        self.token = token
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, idx):
+        item = np.asarray(self.dataset[idx])
+        if self.token is not None:
+            item = np.concatenate([np.full_like(item[:1], self.token), item], axis=0)
+        return item
+
+
+class TokenizeDataset(BaseWrapperDataset):
+    """Symbol -> id mapping via a Dictionary (reference tokenize_dataset.py)."""
+
+    def __init__(self, dataset, dictionary: Dictionary, max_seq_len: int = 512):
+        self.dataset = dataset
+        self.dictionary = dictionary
+        self.max_seq_len = max_seq_len
+
+    @lru_cache(maxsize=16)
+    def __getitem__(self, index: int):
+        raw_data = self.dataset[index]
+        assert 0 < len(raw_data) < self.max_seq_len
+        return self.dictionary.vec_index(raw_data).astype(np.int64)

@@ -1,0 +1,70 @@
+"""Padding collators (reference /root/reference/unicore/data/pad_dataset.py:12-38).
+
+Pads to a multiple of 8 — on TPU this aligns the sequence dimension with the
+VPU sublane width and keeps XLA tile shapes friendly (same constant the
+reference uses for tensor-core alignment).
+"""
+
+from . import data_utils
+from .base_wrapper_dataset import BaseWrapperDataset
+
+
+class PadDataset(BaseWrapperDataset):
+    def __init__(self, dataset, pad_idx, left_pad, pad_to_multiple=8):
+        super().__init__(dataset)
+        self.pad_idx = pad_idx
+        self.left_pad = left_pad
+        self.pad_to_multiple = pad_to_multiple
+
+    def collater(self, samples):
+        return data_utils.collate_tokens(
+            samples,
+            self.pad_idx,
+            left_pad=self.left_pad,
+            pad_to_multiple=self.pad_to_multiple,
+        )
+
+
+class LeftPadDataset(PadDataset):
+    def __init__(self, dataset, pad_idx):
+        super().__init__(dataset, pad_idx, left_pad=True)
+
+
+class RightPadDataset(PadDataset):
+    def __init__(self, dataset, pad_idx):
+        super().__init__(dataset, pad_idx, left_pad=False)
+
+
+class RightPadDataset2D(BaseWrapperDataset):
+    def __init__(self, dataset, pad_idx, left_pad=False, pad_to_multiple=8):
+        super().__init__(dataset)
+        self.pad_idx = pad_idx
+        self.left_pad = left_pad
+        self.pad_to_multiple = pad_to_multiple
+
+    def collater(self, samples):
+        return data_utils.collate_tokens_2d(
+            samples,
+            self.pad_idx,
+            left_pad=self.left_pad,
+            pad_to_multiple=self.pad_to_multiple,
+        )
+
+
+class FixedPadDataset(BaseWrapperDataset):
+    """Pad every batch to a fixed length — guarantees ONE jit compilation
+    across the whole run (no reference equivalent; TPU-native addition)."""
+
+    def __init__(self, dataset, pad_idx, pad_length, left_pad=False):
+        super().__init__(dataset)
+        self.pad_idx = pad_idx
+        self.pad_length = pad_length
+        self.left_pad = left_pad
+
+    def collater(self, samples):
+        return data_utils.collate_tokens(
+            samples,
+            self.pad_idx,
+            left_pad=self.left_pad,
+            pad_to_length=self.pad_length,
+        )

@@ -1,0 +1,77 @@
+"""Dataset protocol (reference /root/reference/unicore/data/unicore_dataset.py:14-91).
+
+Map-style dataset yielding numpy samples; no torch dependency — the iterator
+layer collates on host and the trainer shards onto the device mesh.
+"""
+
+import numpy as np
+
+
+class EpochListening:
+    """Mixin for receiving updates whenever the epoch increments."""
+
+    @property
+    def can_reuse_epoch_itr_across_epochs(self):
+        """Whether one EpochBatchIterator can be reused for future epochs.
+
+        Only safe when the dataset is not epoch-aware (no epoch-seeded
+        masking/shuffling)."""
+        return True
+
+    def set_epoch(self, epoch):
+        """Will receive the updated epoch number at the beginning of the epoch."""
+        pass
+
+
+class UnicoreDataset(EpochListening):
+    """A dataset that provides helpers for batching."""
+
+    def __getitem__(self, index):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def collater(self, samples):
+        """Merge a list of samples to form a mini-batch (numpy arrays)."""
+        raise NotImplementedError
+
+    def num_tokens(self, index: int):
+        """Return the number of tokens in a sample; used for max-tokens batching."""
+        raise NotImplementedError
+
+    def size(self, index: int):
+        """Return an example's size, used for filtering by max-positions."""
+        raise NotImplementedError
+
+    def ordered_indices(self):
+        """Return an ordered list of indices; batches are constructed from it."""
+        return np.arange(len(self), dtype=np.int64)
+
+    @property
+    def supports_prefetch(self):
+        return False
+
+    def attr(self, attr: str, index: int):
+        return getattr(self, attr, None)
+
+    def prefetch(self, indices):
+        raise NotImplementedError
+
+    def batch_by_size(
+        self,
+        indices,
+        batch_size=None,
+        required_batch_size_multiple=1,
+    ):
+        from unicore_tpu.data import data_utils
+
+        return data_utils.batch_by_size(
+            indices,
+            batch_size=batch_size,
+            required_batch_size_multiple=required_batch_size_multiple,
+        )
+
+    @property
+    def supports_fetch_outside_dataloader(self):
+        return True

@@ -1,0 +1,189 @@
+"""Distributed runtime (reference /root/reference/unicore/distributed/utils.py).
+
+TPU-native redesign: the reference's NCCL process groups, torchrun spawning and
+pickle-over-byte-tensor collectives are replaced by
+``jax.distributed.initialize`` (coordinator rendezvous), a
+``jax.sharding.Mesh`` over ICI/DCN whose collectives XLA emits from sharding
+annotations, and ``multihost_utils`` host-level broadcasts.  One process per
+host; per-device parallelism is SPMD inside jit, so there is no
+process-per-GPU spawn boundary (reference utils.py:147-189) to reproduce.
+"""
+
+import logging
+import os
+import socket
+from argparse import Namespace
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def infer_init_method(args):
+    """Infer the coordinator address (reference utils.py:32-106): explicit
+    flag > torchrun-style env (MASTER_ADDR/PORT) > SLURM > single host."""
+    if args.distributed_init_method is not None:
+        return args.distributed_init_method
+    if all(k in os.environ for k in ["MASTER_ADDR", "MASTER_PORT"]):
+        return "{}:{}".format(os.environ["MASTER_ADDR"], os.environ["MASTER_PORT"])
+    if "SLURM_NODELIST" in os.environ and os.environ.get("SLURM_NNODES", "1") != "1":
+        try:
+            import subprocess
+
+            node_list = os.environ["SLURM_NODELIST"]
+            hostnames = subprocess.check_output(
+                ["scontrol", "show", "hostnames", node_list]
+            )
+            host = hostnames.split()[0].decode("utf-8")
+            port = args.distributed_port if args.distributed_port > 0 else 12355
+            return f"{host}:{port}"
+        except Exception:
+            return None
+    return None
+
+
+def distributed_init(args) -> int:
+    """Initialize the multi-host runtime (reference utils.py:109-144).
+
+    Safe to call on a single host (no-op).  Returns the process index.
+    """
+    global _initialized
+    coordinator = infer_init_method(args)
+    num_processes = int(
+        os.environ.get("SLURM_NNODES", os.environ.get("WORLD_SIZE", "1"))
+    )
+    if coordinator is not None and num_processes > 1 and not _initialized:
+        process_id = int(
+            os.environ.get("SLURM_PROCID", os.environ.get("RANK", "0"))
+        )
+        logger.info(
+            f"initializing jax.distributed: coordinator={coordinator} "
+            f"process={process_id}/{num_processes}"
+        )
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    args.distributed_rank = jax.process_index()
+    args.distributed_world_size = jax.device_count()
+    return args.distributed_rank
+
+
+def call_main(args, main, **kwargs):
+    """Entry point (reference utils.py:166-189).  JAX is single-process per
+    host, so no spawn: initialize the cluster (if any) and call main."""
+    distributed_init(args)
+    return main(args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# topology queries (reference utils.py:203-233 — process-group getters)
+# ---------------------------------------------------------------------------
+
+def get_data_parallel_group():
+    """Kept for API parity; sharding specs replace process groups."""
+    return None
+
+
+def get_data_parallel_rank() -> int:
+    return jax.process_index()
+
+
+def get_data_parallel_world_size() -> int:
+    return jax.device_count()
+
+
+def get_global_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def is_master(args) -> bool:
+    return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# host-level collectives (reference utils.py:236-495).  Inside jit, data
+# collectives are emitted by XLA from shardings; these host-level helpers
+# cover the control plane (checkpoint metadata, logging gathers).
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op="sum"):
+    """Host-level all-reduce of a small array across processes."""
+    if jax.process_count() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(tensor)
+    summed = multihost_utils.process_allgather(arr)
+    if op == "sum":
+        return summed.sum(axis=0)
+    elif op == "max":
+        return summed.max(axis=0)
+    elif op == "min":
+        return summed.min(axis=0)
+    else:
+        raise ValueError(f"unsupported op {op}")
+
+
+def all_gather_list(data, group=None, max_size=None):
+    """Gather arbitrary picklable data from all hosts
+    (reference utils.py:275-349 — pickle over a byte tensor; here
+    multihost_utils handles the byte plumbing)."""
+    if jax.process_count() == 1:
+        return [data]
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8)
+    max_size = max_size or 2 ** 20
+    if len(payload) > max_size - 8:
+        raise ValueError(
+            f"encoded data size ({len(payload)}) exceeds max_size ({max_size})"
+        )
+    buf = np.zeros((max_size,), dtype=np.uint8)
+    header = np.frombuffer(
+        np.asarray([len(payload)], dtype=np.uint64).tobytes(), dtype=np.uint8
+    )
+    buf[:8] = header
+    buf[8 : 8 + len(payload)] = payload
+    gathered = multihost_utils.process_allgather(buf)
+    out = []
+    for row in gathered:
+        n = int(np.frombuffer(row[:8].tobytes(), dtype=np.uint64)[0])
+        out.append(pickle.loads(row[8 : 8 + n].tobytes()))
+    return out
+
+
+def all_reduce_dict(data: Dict[str, Any], device=None, group=None) -> Dict[str, Any]:
+    """Sum-reduce a flat dict of scalars across hosts
+    (reference utils.py:352-398)."""
+    if jax.process_count() == 1:
+        return dict(data)
+    keys = sorted(data.keys())
+    vec = np.asarray([float(data[k]) for k in keys], dtype=np.float64)
+    out = all_reduce(vec, op="sum")
+    return {k: out[i] for i, k in enumerate(keys)}
+
+
+def broadcast_object(obj, src_rank=0, group=None):
+    """Broadcast a picklable object from one host to all
+    (reference utils.py:447-495)."""
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        obj, is_source=jax.process_index() == src_rank
+    )

@@ -1,0 +1,1 @@
+from . import meters, metrics, progress_bar  # noqa

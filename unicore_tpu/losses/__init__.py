@@ -1,0 +1,23 @@
+"""Loss registry (reference /root/reference/unicore/losses/__init__.py:17-34)."""
+
+import importlib
+import os
+
+from unicore_tpu.registry import setup_registry
+from .unicore_loss import UnicoreLoss
+
+build_loss_, register_loss, LOSS_REGISTRY = setup_registry(
+    "--loss", base_class=UnicoreLoss, default="cross_entropy"
+)
+
+
+def build_loss(args, task):
+    return build_loss_(args, task)
+
+
+__all__ = ["UnicoreLoss", "LOSS_REGISTRY", "register_loss", "build_loss"]
+
+# Auto-import bundled losses.
+for file in sorted(os.listdir(os.path.dirname(__file__))):
+    if file.endswith(".py") and not file.startswith("_") and file != "unicore_loss.py":
+        importlib.import_module("unicore_tpu.losses." + file[: -len(".py")])

@@ -1,0 +1,44 @@
+"""Cross-entropy loss (reference /root/reference/unicore/losses/cross_entropy.py:13-69)."""
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+from . import register_loss
+from .unicore_loss import UnicoreLoss
+
+
+@register_loss("cross_entropy")
+class CrossEntropyLoss(UnicoreLoss):
+    def forward(self, model, params, sample, rngs=None, train=True):
+        net_output = model.apply(
+            params, **sample["net_input"], train=train, rngs=rngs
+        )
+        logits = net_output[0] if isinstance(net_output, tuple) else net_output
+        target = sample["target"]
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lprobs = lprobs.reshape(-1, lprobs.shape[-1])
+        target = target.reshape(-1)
+        nll = -jnp.take_along_axis(lprobs, target[:, None], axis=-1)[:, 0]
+        loss = jnp.sum(nll)
+        sample_size = jnp.asarray(target.shape[0], dtype=jnp.float32)
+        logging_output = {
+            "loss": loss,
+            "sample_size": sample_size,
+            "bsz": jnp.asarray(
+                sample["target"].shape[0], dtype=jnp.float32
+            ),
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        loss_sum = sum(log.get("loss", 0) for log in logging_outputs)
+        sample_size = sum(log.get("sample_size", 0) for log in logging_outputs)
+        metrics.log_scalar(
+            "loss", loss_sum / sample_size / jnp.log(2), sample_size, round=3
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -1,0 +1,65 @@
+"""Masked-LM loss (reference /root/reference/unicore/losses/masked_lm.py:12-66).
+
+The reference projects only the masked positions (boolean advanced indexing,
+model.py:183-194) — a dynamic shape.  TPU-native design: the model receives
+the boolean ``masked_tokens`` map and the loss weights the per-position NLL by
+it, so XLA sees static shapes; the flagship models additionally support a
+fixed-size masked-position gather (``max_masked`` padding) for the
+memory-saving variant.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+from . import register_loss
+from .unicore_loss import UnicoreLoss
+
+
+@register_loss("masked_lm")
+class MaskedLMLoss(UnicoreLoss):
+    def __init__(self, task):
+        super().__init__(task)
+        self.padding_idx = task.dictionary.pad()
+
+    def forward(self, model, params, sample, rngs=None, train=True):
+        target = sample["target"]
+        masked_tokens = target != self.padding_idx
+        sample_size = jnp.sum(masked_tokens).astype(jnp.float32)
+        logits = model.apply(
+            params,
+            **sample["net_input"],
+            masked_tokens=masked_tokens,
+            train=train,
+            rngs=rngs,
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        safe_target = jnp.where(masked_tokens, target, 0)
+        nll = -jnp.take_along_axis(lprobs, safe_target[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(jnp.where(masked_tokens, nll, 0.0))
+        logging_output = {
+            "loss": loss,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+            "sample_size": sample_size,
+            "seq_len": jnp.asarray(
+                target.shape[1] * target.shape[0], dtype=jnp.float32
+            ),
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        loss_sum = sum(log.get("loss", 0) for log in logging_outputs)
+        bsz = sum(log.get("bsz", 0) for log in logging_outputs)
+        sample_size = sum(log.get("sample_size", 0) for log in logging_outputs)
+        seq_len = sum(log.get("seq_len", 0) for log in logging_outputs)
+        metrics.log_scalar(
+            "loss", loss_sum / sample_size / jnp.log(2), sample_size, round=3
+        )
+        metrics.log_scalar("seq_len", seq_len / bsz, 1, round=3)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -1,0 +1,77 @@
+"""Base loss class.
+
+Capability parity with /root/reference/unicore/losses/unicore_loss.py:29-75,
+re-designed for JAX: a loss is a pure function of
+``(model, params, sample, rngs, train)`` returning
+``(loss, sample_size, logging_output)`` where ``logging_output`` is a flat
+dict of scalar arrays — jit-traceable so the whole train step (forward,
+backward, update, metric reduction) compiles into one XLA program.
+"""
+
+import inspect
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+
+class UnicoreLoss:
+    def __init__(self, task):
+        self.task = task
+        self.args = task.args if task is not None else None
+
+    @classmethod
+    def add_args(cls, parser):
+        pass
+
+    @classmethod
+    def build_loss(cls, args, task):
+        """Construct a loss, reflection-matching ``__init__`` params against
+        args (reference unicore_loss.py:29-57)."""
+        init_args = {}
+        for p in inspect.signature(cls).parameters.values():
+            if (
+                p.kind == p.POSITIONAL_ONLY
+                or p.kind == p.VAR_POSITIONAL
+                or p.kind == p.VAR_KEYWORD
+            ):
+                raise NotImplementedError("losses must take explicit keyword arguments")
+            if p.name == "task":
+                init_args["task"] = task
+            elif hasattr(args, p.name):
+                init_args[p.name] = getattr(args, p.name)
+            elif p.default != p.empty:
+                pass  # we'll use the default value
+            else:
+                raise NotImplementedError(
+                    f"Unable to infer loss argument: {p.name}"
+                )
+        return cls(**init_args)
+
+    def forward(
+        self, model, params, sample, rngs=None, train=True
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, Any]]:
+        """Compute the loss for the given sample.
+
+        Returns ``(loss, sample_size, logging_output)``; the scalar loss is
+        differentiated wrt ``params`` by the trainer and divided by
+        ``sample_size`` (across all micro-batches) before the gradient step —
+        the same normalization contract as the reference
+        (unicore_loss.py:59-66, trainer.py:695-697).
+        """
+        raise NotImplementedError
+
+    def __call__(self, model, params, sample, rngs=None, train=True):
+        return self.forward(model, params, sample, rngs=rngs, train=train)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train: bool) -> bool:
+        """Whether logging outputs from ``forward`` can be summed across
+        data-parallel shards (reference unicore_loss.py:68-75).  Under SPMD
+        the sum happens inside jit; non-summable outputs are gathered on host.
+        """
+        return True
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        """Aggregate logging outputs from micro-batches into metrics."""
+        raise NotImplementedError

@@ -1,0 +1,55 @@
+"""Base model class.
+
+Capability parity with /root/reference/unicore/models/unicore_model.py:18-58,
+re-designed for JAX: a model is a ``flax.linen.Module`` subclass describing
+pure functions; parameters live outside the model in the TrainState pytree.
+``build_model(args, task)`` constructs the module; ``init_params(rng, batch)``
+produces the parameter pytree from a sample batch.
+"""
+
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+
+
+class BaseUnicoreModel(nn.Module):
+    """Base class for all models (reference unicore_model.py:18).
+
+    Subclasses are flax modules: define fields + ``__call__``.  The
+    registry contract mirrors the reference: ``add_args`` injects CLI flags,
+    ``build_model(args, task)`` constructs the module instance.
+    """
+
+    @classmethod
+    def add_args(cls, parser):
+        """Add model-specific arguments to the parser."""
+        pass
+
+    @classmethod
+    def build_model(cls, args, task):
+        """Build a new model instance (reference unicore_model.py:28-33)."""
+        raise NotImplementedError("Model must implement the build_model method")
+
+    def init_params(self, rng: jax.Array, sample: Dict[str, Any]):
+        """Initialize the parameter pytree from an example batch.
+
+        Default: call the module with the batch's ``net_input``.  Subclasses
+        with non-standard signatures override this.
+        """
+        net_input = sample["net_input"] if "net_input" in sample else sample
+        return self.init({"params": rng, "dropout": rng}, **net_input)
+
+    def get_targets(self, sample, net_output):
+        """Get targets from either the sample or the net's output."""
+        return sample["target"]
+
+    def load_state_dict(self, params, state_dict, strict=True, model_args=None):
+        """Copy checkpoint params into this model's pytree layout.
+
+        Replaces torch ``load_state_dict`` (reference unicore_model.py:36-48):
+        operates on pytrees; ``strict=False`` keeps current values for missing
+        leaves and drops unexpected ones.
+        """
+        from unicore_tpu.checkpoint_utils import merge_params
+        return merge_params(params, state_dict, strict=strict)

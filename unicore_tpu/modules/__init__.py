@@ -1,0 +1,30 @@
+"""NN module library (reference /root/reference/unicore/modules/__init__.py:1-15)."""
+
+from .layer_norm import LayerNorm, RMSNorm
+from unicore_tpu.ops.softmax_dropout import softmax_dropout
+from .multihead_attention import CrossMultiheadAttention, SelfMultiheadAttention
+from .transformer_encoder import (
+    TransformerEncoder,
+    TransformerEncoderLayer,
+    bert_init,
+    init_bert_params,
+    make_rp_bucket,
+    relative_position_bucket,
+)
+from .transformer_decoder import TransformerDecoder, TransformerDecoderLayer
+
+__all__ = [
+    "CrossMultiheadAttention",
+    "LayerNorm",
+    "RMSNorm",
+    "SelfMultiheadAttention",
+    "TransformerDecoder",
+    "TransformerDecoderLayer",
+    "TransformerEncoder",
+    "TransformerEncoderLayer",
+    "bert_init",
+    "init_bert_params",
+    "make_rp_bucket",
+    "relative_position_bucket",
+    "softmax_dropout",
+]

@@ -1,0 +1,59 @@
+"""LayerNorm (reference /root/reference/unicore/modules/layer_norm.py).
+
+The reference dispatches to a fused CUDA kernel for a fixed dim set; on TPU
+XLA fuses layer-norm chains natively, so this is a thin flax module with the
+same semantics: eps=1e-5, elementwise affine (weight=1, bias=0 init), fp32
+statistics regardless of input dtype (the CUDA kernel's accumulator
+behavior), output cast back to the input dtype.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class LayerNorm(nn.Module):
+    normalized_shape: int
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.elementwise_affine
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        weight = self.param(
+            "weight", nn.initializers.ones, (self.normalized_shape,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.normalized_shape,), jnp.float32
+        )
+        y = y * weight + bias
+        return y.astype(dtype)
+
+
+class RMSNorm(nn.Module):
+    """RMSNorm (reference /root/reference/unicore/modules/rms_norm.py):
+    no mean subtraction, scale-only affine, fp32 statistics."""
+
+    normalized_shape: int
+    eps: float = 1e-6
+    elementwise_affine: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.elementwise_affine
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf / jnp.sqrt(ms + self.eps)
+        weight = self.param(
+            "weight", nn.initializers.ones, (self.normalized_shape,), jnp.float32
+        )
+        y = y * weight
+        return y.astype(dtype)

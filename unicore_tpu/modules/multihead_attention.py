@@ -1,0 +1,199 @@
+"""Multi-head attention with pair-bias support
+(reference /root/reference/unicore/modules/multihead_attention.py).
+
+TPU-native design: attention stays in (B, H, L, D) layout (one batched
+einsum -> MXU), the softmax(+bias)(+dropout) goes through
+:func:`unicore_tpu.ops.softmax_dropout` (XLA-fused), and the key-padding mask
+becomes an additive -inf mask instead of the reference's in-place
+masked_fill.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.ops.softmax_dropout import softmax_dropout
+
+
+def _split_heads(x, num_heads):
+    b, l, d = x.shape
+    return x.reshape(b, l, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, l, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, l, h * d)
+
+
+def _bias_to_bhll(bias, bsz, num_heads, tgt_len, src_len):
+    """Accept bias shaped (B,H,Q,K), (H,Q,K), (B*H,Q,K), (G,Q,K) with
+    B*H % G == 0, or broadcastable — the reference's bias generality
+    (softmax_dropout.py:71-97)."""
+    if bias is None:
+        return None
+    target = (bsz, num_heads, tgt_len, src_len)
+    if bias.ndim == 4:
+        return jnp.broadcast_to(bias, target)
+    if bias.ndim == 3:
+        g = bias.shape[0]
+        if g == num_heads:
+            return jnp.broadcast_to(bias[None], target)
+        if g == bsz * num_heads:
+            return bias.reshape(target)
+        if (bsz * num_heads) % g == 0:
+            rep = (bsz * num_heads) // g
+            return jnp.tile(bias, (rep, 1, 1)).reshape(target)
+    if bias.ndim == 2:
+        return jnp.broadcast_to(bias[None, None], target)
+    raise ValueError(f"unsupported attn bias shape {bias.shape}")
+
+
+class SelfMultiheadAttention(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.1
+    bias: bool = True
+    scaling_factor: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        query,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_bias: Optional[jnp.ndarray] = None,
+        return_attn: bool = False,
+        train: bool = False,
+    ):
+        bsz, tgt_len, embed_dim = query.shape
+        assert embed_dim == self.embed_dim
+        head_dim = embed_dim // self.num_heads
+        assert head_dim * self.num_heads == embed_dim
+        scaling = (head_dim * self.scaling_factor) ** -0.5
+
+        dense = nn.Dense(
+            3 * embed_dim,
+            use_bias=self.bias,
+            name="in_proj",
+            kernel_init=nn.initializers.normal(0.02),
+            dtype=query.dtype,
+            param_dtype=jnp.float32,
+        )
+        qkv = dense(query)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _split_heads(q, self.num_heads) * scaling
+        k = _split_heads(k, self.num_heads)
+        v = _split_heads(v, self.num_heads)
+        src_len = k.shape[2]
+
+        # (B,H,Q,K) logits — one batched matmul on the MXU
+        attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+
+        if key_padding_mask is not None and key_padding_mask.ndim != 0:
+            neg = jnp.asarray(jnp.finfo(jnp.float32).min, attn_weights.dtype)
+            attn_weights = jnp.where(
+                key_padding_mask[:, None, None, :].astype(bool), neg, attn_weights
+            )
+
+        bias4 = _bias_to_bhll(attn_bias, bsz, self.num_heads, tgt_len, src_len)
+
+        dropout_rng = None
+        if train and self.dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        if not return_attn:
+            attn = softmax_dropout(
+                attn_weights,
+                self.dropout,
+                is_training=train,
+                bias=bias4,
+                dropout_rng=dropout_rng,
+            )
+        else:
+            if bias4 is not None:
+                attn_weights = attn_weights + bias4
+            attn = softmax_dropout(
+                attn_weights,
+                self.dropout,
+                is_training=train,
+                dropout_rng=dropout_rng,
+                inplace=False,
+            )
+
+        o = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        o = _merge_heads(o)
+        o = nn.Dense(
+            embed_dim,
+            use_bias=self.bias,
+            name="out_proj",
+            kernel_init=nn.initializers.normal(0.02),
+            dtype=query.dtype,
+            param_dtype=jnp.float32,
+        )(o)
+        if not return_attn:
+            return o
+        else:
+            return o, attn_weights, attn
+
+
+class CrossMultiheadAttention(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.1
+    bias: bool = True
+    scaling_factor: float = 1.0
+
+    @nn.compact
+    def __call__(
+        self,
+        query,
+        key,
+        value,
+        key_padding_mask: Optional[jnp.ndarray] = None,
+        attn_bias: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ):
+        bsz, tgt_len, embed_dim = query.shape
+        assert embed_dim == self.embed_dim
+        head_dim = embed_dim // self.num_heads
+        scaling = (head_dim * self.scaling_factor) ** -0.5
+
+        mk_dense = lambda name: nn.Dense(
+            embed_dim,
+            use_bias=self.bias,
+            name=name,
+            kernel_init=nn.initializers.normal(0.02),
+            dtype=query.dtype,
+            param_dtype=jnp.float32,
+        )
+        q = _split_heads(mk_dense("q_proj")(query), self.num_heads) * scaling
+        k = _split_heads(mk_dense("k_proj")(key), self.num_heads)
+        v = _split_heads(mk_dense("v_proj")(value), self.num_heads)
+        src_len = k.shape[2]
+
+        attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+
+        if key_padding_mask is not None and key_padding_mask.ndim != 0:
+            neg = jnp.asarray(jnp.finfo(jnp.float32).min, attn_weights.dtype)
+            attn_weights = jnp.where(
+                key_padding_mask[:, None, None, :].astype(bool), neg, attn_weights
+            )
+
+        bias4 = _bias_to_bhll(attn_bias, bsz, self.num_heads, tgt_len, src_len)
+
+        dropout_rng = None
+        if train and self.dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+
+        attn = softmax_dropout(
+            attn_weights,
+            self.dropout,
+            is_training=train,
+            bias=bias4,
+            dropout_rng=dropout_rng,
+        )
+        o = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        o = _merge_heads(o)
+        o = mk_dense("out_proj")(o)
+        return o

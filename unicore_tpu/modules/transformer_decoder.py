@@ -1,0 +1,220 @@
+"""Transformer decoder stack
+(reference /root/reference/unicore/modules/transformer_decoder.py,
+transformer_decoder_layer.py): self-attention (optionally causal) +
+cross-attention + FFN, pre-/post-LN, bucketed rel-pos bias.
+"""
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu import utils
+from .layer_norm import LayerNorm
+from .multihead_attention import CrossMultiheadAttention, SelfMultiheadAttention
+from .transformer_encoder import bert_init, make_rp_bucket
+
+
+class TransformerDecoderLayer(nn.Module):
+    embed_dim: int = 768
+    ffn_embed_dim: int = 3072
+    attention_heads: int = 8
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    activation_fn: str = "gelu"
+    post_ln: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        encoder_out: Optional[jnp.ndarray] = None,
+        attn_bias: Optional[jnp.ndarray] = None,
+        padding_mask: Optional[jnp.ndarray] = None,
+        encoder_attn_bias: Optional[jnp.ndarray] = None,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ):
+        act = utils.get_activation_fn(self.activation_fn)
+        dropout = partial(nn.Dropout(rate=self.dropout), deterministic=not train)
+        act_dropout = partial(
+            nn.Dropout(rate=self.activation_dropout), deterministic=not train
+        )
+
+        residual = x
+        ln_self = LayerNorm(self.embed_dim, name="self_attn_layer_norm")
+        if not self.post_ln:
+            x = ln_self(x)
+        x = SelfMultiheadAttention(
+            self.embed_dim,
+            self.attention_heads,
+            dropout=self.attention_dropout,
+            name="self_attn",
+        )(x, key_padding_mask=padding_mask, attn_bias=attn_bias, train=train)
+        x = dropout(x)
+        x = residual + x
+        if self.post_ln:
+            x = ln_self(x)
+
+        ln_enc = LayerNorm(self.embed_dim, name="encoder_attn_layer_norm")
+        cross = CrossMultiheadAttention(
+            self.embed_dim,
+            self.attention_heads,
+            dropout=self.attention_dropout,
+            name="encoder_attn",
+        )
+        if encoder_out is not None:
+            residual = x
+            if not self.post_ln:
+                x = ln_enc(x)
+            x = cross(
+                x,
+                encoder_out,
+                encoder_out,
+                key_padding_mask=encoder_padding_mask,
+                attn_bias=encoder_attn_bias,
+                train=train,
+            )
+            x = dropout(x)
+            x = residual + x
+            if self.post_ln:
+                x = ln_enc(x)
+
+        residual = x
+        ln_final = LayerNorm(self.embed_dim, name="final_layer_norm")
+        if not self.post_ln:
+            x = ln_final(x)
+        x = nn.Dense(
+            self.ffn_embed_dim, name="fc1", kernel_init=bert_init,
+            dtype=x.dtype, param_dtype=jnp.float32,
+        )(x)
+        x = act(x)
+        x = act_dropout(x)
+        x = nn.Dense(
+            self.embed_dim, name="fc2", kernel_init=bert_init,
+            dtype=x.dtype, param_dtype=jnp.float32,
+        )(x)
+        x = dropout(x)
+        x = residual + x
+        if self.post_ln:
+            x = ln_final(x)
+        return x
+
+
+class TransformerDecoder(nn.Module):
+    decoder_layers: int = 6
+    embed_dim: int = 768
+    ffn_embed_dim: int = 3072
+    attention_heads: int = 8
+    emb_dropout: float = 0.1
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    max_seq_len: int = 256
+    activation_fn: str = "gelu"
+    rel_pos: bool = True
+    rel_pos_bins: int = 32
+    max_rel_pos: int = 128
+    post_ln: bool = False
+    auto_regressive: bool = True
+
+    def setup(self):
+        self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
+        self.emb_dropout_module = nn.Dropout(rate=self.emb_dropout)
+        if not self.post_ln:
+            self.final_layer_norm = LayerNorm(self.embed_dim, name="final_layer_norm")
+        self.layers = [
+            TransformerDecoderLayer(
+                embed_dim=self.embed_dim,
+                ffn_embed_dim=self.ffn_embed_dim,
+                attention_heads=self.attention_heads,
+                dropout=self.dropout,
+                attention_dropout=self.attention_dropout,
+                activation_dropout=self.activation_dropout,
+                activation_fn=self.activation_fn,
+                post_ln=self.post_ln,
+                name=f"layers_{i}",
+            )
+            for i in range(self.decoder_layers)
+        ]
+        if self.rel_pos:
+            assert self.rel_pos_bins % 2 == 0
+            self.relative_attention_bias = nn.Embed(
+                self.rel_pos_bins,
+                self.attention_heads,
+                embedding_init=bert_init,
+                name="relative_attention_bias",
+                param_dtype=jnp.float32,
+            )
+            self._rp_bucket = make_rp_bucket(
+                self.max_seq_len, self.rel_pos_bins, self.max_rel_pos
+            )
+
+    def get_rel_pos_bias(self, seq_len):
+        rp_bucket = jnp.asarray(self._rp_bucket[:seq_len, :seq_len])
+        values = self.relative_attention_bias(rp_bucket)
+        return values.transpose(2, 0, 1)
+
+    def __call__(
+        self,
+        emb,
+        encoder_out: Optional[jnp.ndarray] = None,
+        padding_mask: Optional[jnp.ndarray] = None,
+        encoder_padding_mask: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,
+        encoder_attn_mask: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        bsz, seq_len, _ = emb.shape
+        x = self.emb_layer_norm(emb)
+        x = self.emb_dropout_module(x, deterministic=not train)
+
+        if padding_mask is not None:
+            x = x * (1 - padding_mask[..., None].astype(x.dtype))
+
+        rel_pos_bias = self.get_rel_pos_bias(seq_len) if self.rel_pos else None
+        if attn_mask is None:
+            attn_bias = rel_pos_bias
+        elif rel_pos_bias is not None:
+            attn_bias = attn_mask + rel_pos_bias
+        else:
+            attn_bias = attn_mask
+
+        if self.auto_regressive:
+            # additive causal mask (reference builds a -inf triu buffer)
+            causal = jnp.triu(
+                jnp.full((seq_len, seq_len), jnp.finfo(jnp.float32).min), 1
+            )
+            attn_bias = causal if attn_bias is None else attn_bias + causal
+
+        if attn_bias is not None and padding_mask is not None:
+            attn_bias = jnp.broadcast_to(
+                attn_bias.reshape((-1,) + attn_bias.shape[-3:])
+                if attn_bias.ndim > 3
+                else (attn_bias[None] if attn_bias.ndim == 3 else attn_bias[None, None]),
+                (bsz, self.attention_heads, seq_len, seq_len),
+            )
+            neg = jnp.finfo(jnp.float32).min
+            attn_bias = jnp.where(
+                padding_mask[:, None, None, :].astype(bool), neg, attn_bias
+            )
+            padding_mask = None
+
+        for layer in self.layers:
+            x = layer(
+                x,
+                encoder_out=encoder_out,
+                padding_mask=padding_mask,
+                attn_bias=attn_bias,
+                encoder_padding_mask=encoder_padding_mask,
+                encoder_attn_bias=encoder_attn_mask,
+                train=train,
+            )
+
+        if not self.post_ln:
+            x = self.final_layer_norm(x)
+        return x

@@ -1,0 +1,6 @@
+"""TPU op library — jnp reference implementations with Pallas fast paths.
+
+Counterpart of the reference's csrc/ CUDA extensions (SURVEY.md §2.2).
+"""
+
+from .softmax_dropout import softmax_dropout  # noqa

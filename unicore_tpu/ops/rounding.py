@@ -1,0 +1,34 @@
+"""Stochastic rounding fp32 -> bf16.
+
+TPU-native counterpart of the reference's ``unicore_fused_rounding`` CUDA
+extension (/root/reference/csrc/rounding/fp32_to_bf16.cu:23-39): add 16
+random low bits to the fp32 bit pattern, truncate the mantissa, reinterpret
+the top 16 bits as bf16.  Used by the mixed-precision optimizer's
+master->param copy-back when ``--bf16-sr`` is set
+(reference fp16_optimizer.py:212-215) — unbiased rounding keeps tiny
+gradient updates from being systematically lost to bf16's 8-bit mantissa.
+
+Implemented with jnp bit ops (XLA fuses this into the optimizer update, so
+it costs no extra HBM pass).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fp32_to_bf16_sr(x: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """Stochastically round an fp32 array to bf16."""
+    assert x.dtype == jnp.float32, f"expected float32, got {x.dtype}"
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, dtype=jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = bits + noise
+    top = (rounded >> 16).astype(jnp.uint16)
+    return jax.lax.bitcast_convert_type(top, jnp.bfloat16)
+
+
+def tree_fp32_to_bf16_sr(tree, key: jax.Array):
+    """Apply SR rounding over a pytree with decorrelated per-leaf keys."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    out = [fp32_to_bf16_sr(leaf, k) for leaf, k in zip(leaves, keys)]
+    return jax.tree_util.tree_unflatten(treedef, out)

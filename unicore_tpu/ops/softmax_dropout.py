@@ -1,0 +1,84 @@
+"""Fused softmax(+mask)(+bias)(+dropout).
+
+TPU-native counterpart of the reference's ``unicore_fused_softmax_dropout``
+CUDA extension (/root/reference/csrc/softmax_dropout/ and
+unicore/modules/softmax_dropout.py): the same op surface — optional additive
+mask and bias with the reference's broadcast semantics (_check_mask /
+_check_bias, softmax_dropout.py:53-97) — implemented as a jnp composition that
+XLA fuses into a single kernel on TPU.  The softmax runs in fp32 regardless of
+input dtype (matching the CUDA kernel's accumulator) and the dropout mask is
+never materialized in HBM separately from the fused computation.
+
+This op is the API for modules that need materialized probabilities
+(``return_attn`` consumers like Uni-Fold's triangle attention); the memory-
+bound long-sequence cases are covered by the Pallas flash-attention kernel
+in ops/ once present.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _broadcastable_to(shape, target):
+    if len(shape) != len(target):
+        return False
+    return all(s == t or s == 1 for s, t in zip(shape, target))
+
+
+def _expand_extra(x: jnp.ndarray, input_shape) -> Optional[jnp.ndarray]:
+    """Broadcast mask/bias to the input shape under the reference's rules:
+    trailing dims must match or be 1; a leading batch dim ``b`` with
+    ``input.size(0) % b == 0`` repeats (the Uni-Fold triangle-attention
+    layout, reference interface.cpp:37-48)."""
+    if x is None:
+        return None
+    if x.ndim < len(input_shape):
+        x = x.reshape((1,) * (len(input_shape) - x.ndim) + x.shape)
+    if _broadcastable_to(x.shape, input_shape):
+        return jnp.broadcast_to(x, input_shape)
+    # reference semantics: flatten leading dims; input rows divisible by bias rows
+    rows_in = 1
+    for s in input_shape[:-2]:
+        rows_in *= s
+    rows_x = 1
+    for s in x.shape[:-2]:
+        rows_x *= s
+    if rows_in % rows_x == 0:
+        x = x.reshape((rows_x,) + x.shape[-2:])
+        x = jnp.tile(x, (rows_in // rows_x, 1, 1))
+        return x.reshape(input_shape)
+    raise ValueError(
+        f"mask/bias shape {x.shape} not broadcastable to input {input_shape}"
+    )
+
+
+def softmax_dropout(
+    input: jnp.ndarray,
+    dropout_prob: float,
+    is_training: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    dropout_rng: Optional[jax.Array] = None,
+    inplace: bool = True,  # kept for API parity; functional arrays ignore it
+) -> jnp.ndarray:
+    """softmax(input [+ mask] [+ bias]) with optional dropout.
+
+    Mirrors reference modules/softmax_dropout.py:100-144.  ``dropout_rng`` is
+    required when ``is_training and dropout_prob > 0``.
+    """
+    dtype = input.dtype
+    x = input.astype(jnp.float32)
+    if mask is not None:
+        x = x + _expand_extra(mask.astype(jnp.float32), x.shape)
+    if bias is not None:
+        x = x + _expand_extra(bias.astype(jnp.float32), x.shape)
+    probs = jax.nn.softmax(x, axis=-1)
+    probs = probs.astype(dtype)
+    if is_training and dropout_prob > 0.0:
+        if dropout_rng is None:
+            raise ValueError("softmax_dropout needs dropout_rng when training with dropout")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0).astype(dtype)
+    return probs

@@ -1,0 +1,34 @@
+"""Optimizer registry (reference /root/reference/unicore/optim/__init__.py:22-30)."""
+
+import importlib
+import os
+
+from unicore_tpu.registry import setup_registry
+from .unicore_optimizer import UnicoreOptimizer  # noqa
+from .dynamic_loss_scaler import DynamicLossScaler  # noqa
+
+build_optimizer_, register_optimizer, OPTIMIZER_REGISTRY = setup_registry(
+    "--optimizer", base_class=UnicoreOptimizer, default="adam"
+)
+
+
+def build_optimizer(args, *extra_args, **extra_kwargs):
+    return build_optimizer_(args, *extra_args, **extra_kwargs)
+
+
+__all__ = [
+    "DynamicLossScaler",
+    "UnicoreOptimizer",
+    "OPTIMIZER_REGISTRY",
+    "build_optimizer",
+    "register_optimizer",
+]
+
+# Auto-import bundled optimizers.
+for file in sorted(os.listdir(os.path.dirname(__file__))):
+    if (
+        file.endswith(".py")
+        and not file.startswith("_")
+        and file not in ("unicore_optimizer.py", "dynamic_loss_scaler.py")
+    ):
+        importlib.import_module("unicore_tpu.optim." + file[: -len(".py")])

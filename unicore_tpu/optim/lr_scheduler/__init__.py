@@ -1,0 +1,28 @@
+"""LR scheduler registry
+(reference /root/reference/unicore/optim/lr_scheduler/__init__.py:17-27)."""
+
+import importlib
+import os
+
+from unicore_tpu import registry
+from .unicore_lr_scheduler import UnicoreLRScheduler  # noqa
+
+(
+    build_lr_scheduler_,
+    register_lr_scheduler,
+    LR_SCHEDULER_REGISTRY,
+) = registry.setup_registry(
+    "--lr-scheduler", base_class=UnicoreLRScheduler, default="fixed"
+)
+
+
+def build_lr_scheduler(args, optimizer, total_train_steps):
+    return build_lr_scheduler_(args, optimizer, total_train_steps)
+
+
+# automatically import any Python files in this directory
+for file in sorted(os.listdir(os.path.dirname(__file__))):
+    if file.endswith(".py") and not file.startswith("_") and file != "unicore_lr_scheduler.py":
+        importlib.import_module(
+            "unicore_tpu.optim.lr_scheduler." + file[: file.find(".py")]
+        )

@@ -1,0 +1,42 @@
+"""Exponential decay schedule
+(reference /root/reference/unicore/optim/lr_scheduler/exponential_decay_schedule.py:11)."""
+
+from . import UnicoreLRScheduler, register_lr_scheduler
+
+
+@register_lr_scheduler("exponential_decay")
+class ExponentialDecayLRSchedule(UnicoreLRScheduler):
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__(args, optimizer, total_train_steps)
+        self.warmup_updates = args.warmup_updates
+        self.lr = args.lr[0]
+        if self.warmup_updates > 0:
+            self.warmup_factor = 1.0 / self.warmup_updates
+        else:
+            self.warmup_factor = 1.0
+        self.decay_ratio = args.decay_ratio
+        self.decay_steps = args.decay_steps
+        self.set_lr(self.warmup_factor * self.lr)
+        self.stair_decay = getattr(args, "stair_decay", False)
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument('--warmup-updates', default=1000, type=int, metavar='N',
+                            help='warmup the learning rate linearly for the first N updates')
+        parser.add_argument('--decay-ratio', default=0.95, type=float)
+        parser.add_argument('--decay-steps', default=500, type=int)
+        parser.add_argument('--stair-decay', action="store_true")
+
+    def step_update(self, num_updates):
+        if self.warmup_updates > 0 and num_updates <= self.warmup_updates:
+            self.warmup_factor = num_updates / float(self.warmup_updates)
+            lr = self.warmup_factor * self.lr
+        else:
+            if self.stair_decay:
+                step = num_updates
+                lr = self.lr * float(self.decay_ratio ** int(step // self.decay_steps))
+            else:
+                step = num_updates - self.warmup_updates
+                lr = self.lr * float(self.decay_ratio ** float(step / self.decay_steps))
+        self.set_lr(lr)
+        return self.get_lr()

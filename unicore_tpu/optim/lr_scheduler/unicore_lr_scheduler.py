@@ -1,0 +1,57 @@
+"""LR scheduler base
+(reference /root/reference/unicore/optim/lr_scheduler/unicore_lr_scheduler.py:12-49).
+
+Schedulers run host-side: the trainer calls ``step_update(num_updates)`` each
+step and passes the returned float into the jitted train step as a traced
+scalar — cheap host math, no recompile, and plateau-style schedules that need
+validation losses work unchanged.
+"""
+
+from argparse import Namespace
+
+
+class UnicoreLRScheduler(object):
+    def __init__(self, args, optimizer, total_train_steps):
+        super().__init__()
+        self.args = args
+        self.optimizer = optimizer
+        self.total_train_steps = total_train_steps
+        self.best = None
+        self._lr = args.lr[0] if isinstance(getattr(args, "lr", None), list) else getattr(args, "lr", 0.0)
+
+    @classmethod
+    def add_args(cls, parser):
+        """Add arguments to the parser for this LR scheduler."""
+        pass
+
+    # the functional optimizer takes lr as a step argument, so the scheduler
+    # itself is the lr owner (replaces optimizer.set_lr/get_lr round-trips)
+    def set_lr(self, lr):
+        self._lr = lr
+
+    def get_lr(self):
+        return self._lr
+
+    def state_dict(self):
+        return {"best": self.best, "lr": self._lr}
+
+    def load_state_dict(self, state_dict):
+        self.best = state_dict.get("best", None)
+        if "lr" in state_dict:
+            self._lr = state_dict["lr"]
+
+    def step_begin_epoch(self, epoch):
+        """Update the learning rate at the beginning of the given epoch."""
+        pass
+
+    def step(self, epoch, val_loss=None):
+        """Update the learning rate at the end of the given epoch."""
+        if val_loss is not None:
+            if self.best is None:
+                self.best = val_loss
+            else:
+                self.best = min(self.best, val_loss)
+
+    def step_update(self, num_updates):
+        """Update the learning rate after each update."""
+        return self.get_lr()

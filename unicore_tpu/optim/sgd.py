@@ -1,0 +1,124 @@
+"""SGD / Adagrad / Adadelta optimizers
+(reference /root/reference/unicore/optim/{sgd,adagrad,adadelta}.py — thin
+registry wrappers there; native fp32 implementations here).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import register_optimizer
+from .unicore_optimizer import UnicoreOptimizer
+
+
+def _tree_zip_map(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+@register_optimizer("sgd")
+class SGD(UnicoreOptimizer):
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument("--momentum", default=0.0, type=float, metavar="M",
+                            help="momentum factor")
+        parser.add_argument("--weight-decay", "--wd", default=0.0, type=float,
+                            metavar="WD", help="weight decay")
+
+    def _init_slots(self, master_params):
+        if getattr(self.args, "momentum", 0.0) != 0.0:
+            return {
+                "momentum": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), master_params
+                )
+            }
+        return {}
+
+    def _apply_update(self, grads32, slots, master, lr, step, decay_mask):
+        mu = getattr(self.args, "momentum", 0.0)
+        wd = getattr(self.args, "weight_decay", 0.0)
+
+        def add_wd(g, p, d):
+            return g + jnp.where(d, wd * p, 0.0) if wd != 0.0 else g
+
+        grads32 = _tree_zip_map(add_wd, grads32, master, decay_mask)
+        if mu != 0.0:
+            new_mom = _tree_zip_map(
+                lambda b, g: mu * b + g, slots["momentum"], grads32
+            )
+            new_p = _tree_zip_map(lambda p, b: p - lr * b, master, new_mom)
+            return new_p, {"momentum": new_mom}
+        new_p = _tree_zip_map(lambda p, g: p - lr * g, master, grads32)
+        return new_p, {}
+
+
+@register_optimizer("adagrad")
+class Adagrad(UnicoreOptimizer):
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument("--weight-decay", "--wd", default=0.0, type=float,
+                            metavar="WD", help="weight decay")
+        parser.add_argument("--adagrad-eps", default=1e-10, type=float)
+
+    def _init_slots(self, master_params):
+        return {
+            "sum": jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), master_params
+            )
+        }
+
+    def _apply_update(self, grads32, slots, master, lr, step, decay_mask):
+        wd = getattr(self.args, "weight_decay", 0.0)
+        eps = getattr(self.args, "adagrad_eps", 1e-10)
+
+        def add_wd(g, p, d):
+            return g + jnp.where(d, wd * p, 0.0) if wd != 0.0 else g
+
+        grads32 = _tree_zip_map(add_wd, grads32, master, decay_mask)
+        new_sum = _tree_zip_map(lambda s, g: s + jnp.square(g), slots["sum"], grads32)
+        new_p = _tree_zip_map(
+            lambda p, g, s: p - lr * g / (jnp.sqrt(s) + eps),
+            master, grads32, new_sum,
+        )
+        return new_p, {"sum": new_sum}
+
+
+@register_optimizer("adadelta")
+class Adadelta(UnicoreOptimizer):
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument("--adadelta-rho", type=float, default=0.9, metavar="RHO",
+                            help="coefficient used for computing a running average")
+        parser.add_argument("--adadelta-eps", type=float, default=1e-6, metavar="EPS",
+                            help="term added to the denominator")
+        parser.add_argument("--weight-decay", "--wd", default=0.0, type=float,
+                            metavar="WD", help="weight decay")
+
+    def _init_slots(self, master_params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {
+            "square_avg": jax.tree_util.tree_map(zeros, master_params),
+            "acc_delta": jax.tree_util.tree_map(zeros, master_params),
+        }
+
+    def _apply_update(self, grads32, slots, master, lr, step, decay_mask):
+        rho = getattr(self.args, "adadelta_rho", 0.9)
+        eps = getattr(self.args, "adadelta_eps", 1e-6)
+        wd = getattr(self.args, "weight_decay", 0.0)
+
+        def add_wd(g, p, d):
+            return g + jnp.where(d, wd * p, 0.0) if wd != 0.0 else g
+
+        grads32 = _tree_zip_map(add_wd, grads32, master, decay_mask)
+        new_sq = _tree_zip_map(
+            lambda s, g: rho * s + (1 - rho) * jnp.square(g),
+            slots["square_avg"], grads32,
+        )
+        delta = _tree_zip_map(
+            lambda a, s, g: jnp.sqrt(a + eps) / jnp.sqrt(s + eps) * g,
+            slots["acc_delta"], new_sq, grads32,
+        )
+        new_acc = _tree_zip_map(
+            lambda a, dd: rho * a + (1 - rho) * jnp.square(dd),
+            slots["acc_delta"], delta,
+        )
+        new_p = _tree_zip_map(lambda p, dd: p - lr * dd, master, delta)
+        return new_p, {"square_avg": new_sq, "acc_delta": new_acc}

@@ -1,0 +1,198 @@
+"""Optimizer base class.
+
+Capability parity with /root/reference/unicore/optim/unicore_optimizer.py and
+fp16_optimizer.py, re-designed functionally: an optimizer is
+``init_state(params) -> state`` plus a pure
+``update(grads, state, params, lr, *, sr_rng) -> (new_params, new_state)``
+that jit-compiles into the train step.  Mixed-precision policy (the entire
+FP16/BF16 optimizer wrapper stack, fp16_optimizer.py:16-392) collapses into:
+
+- params may live in bf16/fp16; the fp32 master copy lives inside the
+  optimizer state (``state['master']``) — per-rank, optionally ZeRO-1-sharded
+  over the data axis by the trainer's sharding specs;
+- grads arrive in compute dtype, are accumulated/reduced in fp32 when
+  ``--allreduce-fp32-grad`` (the scan carry dtype), and the update math is
+  always fp32;
+- copy-back master->bf16 uses stochastic rounding when ``--bf16-sr``
+  (ops/rounding.py);
+- no param flattening: XLA fuses the per-leaf updates into few kernels, the
+  problem the flat buffer solved (kernel-launch storms) does not exist.
+
+``separate_decay_params`` semantics (bias / 1-dim / name-listed params get
+weight_decay=0, fp16_optimizer.py:16-43) are kept via a decay-mask pytree.
+"""
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import utils
+from unicore_tpu.ops.rounding import fp32_to_bf16_sr
+
+logger = logging.getLogger(__name__)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def make_decay_mask(params, no_decay_names=("bias", "layer_norm", "layernorm")):
+    """True where weight decay applies (reference separate_decay_params,
+    fp16_optimizer.py:16-43: bias / rank<=1 / named params excluded)."""
+
+    def mask_leaf(path, leaf):
+        name = _path_str(path).lower()
+        if leaf.ndim <= 1:
+            return False
+        if any(nd in name for nd in no_decay_names):
+            return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(mask_leaf, params)
+
+
+class UnicoreOptimizer(object):
+    def __init__(self, args):
+        super().__init__()
+        self.args = args
+
+    @classmethod
+    def add_args(cls, parser):
+        pass
+
+    @property
+    def supports_flat_params(self):
+        """Kept for API parity; pytrees make flattening unnecessary."""
+        return False
+
+    @property
+    def supports_step_with_scale(self):
+        return True
+
+    # ------------------------------------------------------------------
+    # functional core — subclasses implement _init_slots and _apply_update
+    # ------------------------------------------------------------------
+
+    def _init_slots(self, master_params) -> Dict[str, Any]:
+        """Per-parameter accumulator slots (m, v, ...), fp32."""
+        raise NotImplementedError
+
+    def _apply_update(
+        self, grads32, slots, master, lr, step, decay_mask
+    ) -> Tuple[Any, Dict[str, Any]]:
+        """Pure fp32 update: returns (new_master, new_slots)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+
+    def init_state(self, params) -> Dict[str, Any]:
+        """Build optimizer state.  If params are low-precision, an fp32
+        master copy is created (reference flatten_parameters_fp32,
+        fp16_optimizer.py:99-121 — minus the flattening)."""
+        needs_master = any(
+            leaf.dtype in (jnp.bfloat16, jnp.float16)
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+        master = (
+            jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+            if needs_master
+            else None
+        )
+        slots = self._init_slots(master if master is not None else params)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "master": master,
+            "slots": slots,
+        }
+
+    def update(
+        self,
+        grads,
+        state: Dict[str, Any],
+        params,
+        lr,
+        grad_scale=None,
+        sr_rng: Optional[jax.Array] = None,
+        skip_update=None,
+    ):
+        """One optimizer step, jit-traceable.
+
+        ``grad_scale``: divide grads by this (loss-scale unscaling,
+        sample-size normalization — the reference's deferred
+        ``_multiply_factor``, fp16_optimizer.py:218-239).
+        ``skip_update``: bool scalar; when True the step is a no-op (the
+        branchless version of the reference's OverflowError skip).
+        """
+        step = state["step"] + 1
+        master = state["master"] if state["master"] is not None else params
+        grads32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        if grad_scale is not None:
+            inv = 1.0 / jnp.asarray(grad_scale, dtype=jnp.float32)
+            grads32 = jax.tree_util.tree_map(lambda g: g * inv, grads32)
+
+        decay_mask = make_decay_mask(params)
+        lr = jnp.asarray(lr, dtype=jnp.float32)
+        new_master, new_slots = self._apply_update(
+            grads32, state["slots"], master, lr, step, decay_mask
+        )
+
+        if skip_update is not None:
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(skip_update, o, n), new, old
+            )
+            new_master = keep(new_master, master)
+            new_slots = keep(new_slots, state["slots"])
+            step = jnp.where(skip_update, state["step"], step)
+
+        if state["master"] is not None:
+            # master -> low-precision copy-back, optionally with SR
+            if getattr(self.args, "bf16_sr", False) and sr_rng is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(new_master)
+                keys = jax.random.split(sr_rng, len(leaves))
+                tmpl = jax.tree_util.tree_leaves(params)
+                new_params = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [
+                        fp32_to_bf16_sr(m, k)
+                        if t.dtype == jnp.bfloat16
+                        else m.astype(t.dtype)
+                        for m, k, t in zip(leaves, keys, tmpl)
+                    ],
+                )
+            else:
+                new_params = jax.tree_util.tree_map(
+                    lambda m, p: m.astype(p.dtype), new_master, params
+                )
+            new_state = {"step": step, "master": new_master, "slots": new_slots}
+        else:
+            new_params = new_master
+            new_state = {"step": step, "master": None, "slots": new_slots}
+        return new_params, new_state
+
+    # ------------------------------------------------------------------
+    # host-side API parity helpers
+    # ------------------------------------------------------------------
+
+    def clip_grad_norm(self, grads, max_norm):
+        return utils.clip_grad_norm(grads, max_norm)
+
+    def multiply_grads(self, grads, c):
+        return jax.tree_util.tree_map(lambda g: g * c, grads)
+
+    def state_dict(self, state):
+        return state
+
+    def load_state_dict(self, state, state_dict, optimizer_overrides=None):
+        if optimizer_overrides is not None and len(optimizer_overrides) > 0:
+            self.args.__dict__.update(optimizer_overrides)
+        return state_dict

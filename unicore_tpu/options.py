@@ -1,0 +1,388 @@
+"""CLI flag system with two-phase parsing.
+
+Capability parity with /root/reference/unicore/options.py: a first parse picks
+``--task`` / ``--arch`` / registry choices, the selected classes then inject
+their own flags via ``add_args``, and a second parse produces the final
+namespace (reference options.py:43-156).  Flag groups mirror the reference
+(common / dataset / distributed / optimization / checkpoint) with
+TPU-native semantics where the torch ones make no sense (``--ddp-backend``
+becomes a sharding preset, NCCL knobs become mesh shape flags).
+"""
+
+import argparse
+from typing import Callable, List, Optional
+
+from unicore_tpu import utils
+from unicore_tpu.registry import REGISTRIES
+
+
+def get_preprocessing_parser(default_task="translation"):
+    parser = get_parser("Preprocessing", default_task)
+    return parser
+
+
+def get_training_parser(default_task=None):
+    parser = get_parser("Trainer", default_task)
+    add_dataset_args(parser, train=True)
+    add_distributed_training_args(parser)
+    add_model_args(parser)
+    add_optimization_args(parser)
+    add_checkpoint_args(parser)
+    return parser
+
+
+def get_validation_parser(default_task=None):
+    parser = get_parser("Validation", default_task)
+    add_dataset_args(parser, train=True)
+    add_distributed_training_args(parser)
+    group = parser.add_argument_group("Evaluation")
+    add_common_eval_args(group)
+    return parser
+
+
+def parse_args_and_arch(
+    parser: argparse.ArgumentParser,
+    input_args: List[str] = None,
+    parse_known: bool = False,
+    suppress_defaults: bool = False,
+    modify_parser: Optional[Callable[[argparse.ArgumentParser], None]] = None,
+):
+    """Two-phase parse (reference options.py:43-156)."""
+    if suppress_defaults:
+        # Parse args without any default values. This requires us to parse
+        # twice, once to identify all the necessary task/model args, and a
+        # second time with all defaults set to None.
+        args = parse_args_and_arch(
+            parser, input_args=input_args, parse_known=parse_known,
+            suppress_defaults=False,
+        )
+        suppressed_parser = argparse.ArgumentParser(
+            add_help=False, parents=[parser]
+        )
+        suppressed_parser.set_defaults(
+            **{k: None for k, v in vars(args).items()}
+        )
+        args = suppressed_parser.parse_args(input_args)
+        return argparse.Namespace(
+            **{k: v for k, v in vars(args).items() if v is not None}
+        )
+
+    from unicore_tpu.models import ARCH_MODEL_REGISTRY, ARCH_CONFIG_REGISTRY, MODEL_REGISTRY
+
+    # Before creating the true parser, we need to import optional user module
+    # in order to eagerly import custom tasks, optimizers, architectures, etc.
+    usr_parser = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
+    usr_parser.add_argument("--user-dir", default=None)
+    usr_args, _ = usr_parser.parse_known_args(input_args)
+    utils.import_user_module(usr_args)
+
+    if modify_parser is not None:
+        modify_parser(parser)
+
+    # Phase 1: parse enough to know which classes will add more args.
+    args, _ = parser.parse_known_args(input_args)
+
+    if hasattr(args, "arch"):
+        model_specific_group = parser.add_argument_group(
+            "Model-specific configuration",
+            argument_default=argparse.SUPPRESS,
+        )
+        if args.arch in ARCH_MODEL_REGISTRY:
+            ARCH_MODEL_REGISTRY[args.arch].add_args(model_specific_group)
+        elif args.arch in MODEL_REGISTRY:
+            MODEL_REGISTRY[args.arch].add_args(model_specific_group)
+        else:
+            raise RuntimeError(f"Unknown model architecture: {args.arch}")
+
+    if hasattr(args, "task") and args.task is not None:
+        from unicore_tpu.tasks import TASK_REGISTRY
+        TASK_REGISTRY[args.task].add_args(parser)
+
+    # Let registry choices (optimizer, lr_scheduler, loss) add args too.
+    for registry_name, REGISTRY in REGISTRIES.items():
+        choice = getattr(args, registry_name, None)
+        if choice is not None:
+            cls = REGISTRY["registry"][choice]
+            if hasattr(cls, "add_args"):
+                cls.add_args(parser)
+
+    # Phase 2: the real parse.
+    if parse_known:
+        args, extra = parser.parse_known_args(input_args)
+    else:
+        args = parser.parse_args(input_args)
+        extra = None
+
+    # Post-process.
+    if hasattr(args, "batch_size_valid") and args.batch_size_valid is None:
+        args.batch_size_valid = args.batch_size
+    if hasattr(args, "max_tokens_valid") and args.max_tokens_valid is None:
+        args.max_tokens_valid = getattr(args, "max_tokens", None)
+    if getattr(args, "memory_efficient_fp16", False):
+        args.fp16 = True
+    args.bf16 = getattr(args, "bf16", False)
+    args.fp16 = getattr(args, "fp16", False)
+
+    # Apply architecture configuration (mutates args in place).
+    if hasattr(args, "arch") and args.arch in ARCH_CONFIG_REGISTRY:
+        ARCH_CONFIG_REGISTRY[args.arch](args)
+
+    if parse_known:
+        return args, extra
+    else:
+        return args
+
+
+def get_parser(desc, default_task=None):
+    # Like phase-1 above, pre-import the user module so its registrations are
+    # visible to the registry choice flags.
+    usr_parser = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
+    usr_parser.add_argument("--user-dir", default=None)
+    usr_args, _ = usr_parser.parse_known_args()
+    utils.import_user_module(usr_args)
+
+    parser = argparse.ArgumentParser(allow_abbrev=False)
+    parser.add_argument("--no-progress-bar", action="store_true", help="disable progress bar")
+    parser.add_argument("--log-interval", type=int, default=100, metavar="N",
+                        help="log progress every N batches (when progress bar is disabled)")
+    parser.add_argument("--log-format", default=None, help="log format to use",
+                        choices=["json", "none", "simple", "tqdm"])
+    parser.add_argument("--tensorboard-logdir", metavar="DIR", default="",
+                        help="path to save logs for tensorboard")
+    parser.add_argument("--wandb-project", metavar="WANDB", default="",
+                        help="name of wandb project (empty = no wandb logging)")
+    parser.add_argument("--wandb-name", metavar="WANDBNAME", default="",
+                        help="wandb run name")
+    parser.add_argument("--seed", default=1, type=int, metavar="N",
+                        help="pseudo random number generator seed")
+    parser.add_argument("--cpu", action="store_true", help="use CPU instead of TPU")
+    parser.add_argument("--fp16", action="store_true", help="use FP16 (with dynamic loss scaling)")
+    parser.add_argument("--bf16", action="store_true", help="use BF16 (TPU-native default precision)")
+    parser.add_argument("--bf16-sr", action="store_true",
+                        help="use stochastic rounding on the fp32-master -> bf16 param copy-back")
+    parser.add_argument("--allreduce-fp32-grad", action="store_true",
+                        help="accumulate / all-reduce gradients in fp32 even for bf16 params")
+    parser.add_argument("--fp16-no-flatten-grads", action="store_true",
+                        help="(compat) don't flatten FP16 grads; no-op on TPU pytrees")
+    parser.add_argument("--fp16-init-scale", default=2 ** 7, type=int,
+                        help="default FP16 loss scale")
+    parser.add_argument("--fp16-scale-window", type=int, default=None,
+                        help="number of updates before increasing loss scale")
+    parser.add_argument("--fp16-scale-tolerance", default=0.0, type=float,
+                        help="pct of updates that can overflow before decreasing the loss scale")
+    parser.add_argument("--min-loss-scale", default=1e-4, type=float, metavar="D",
+                        help="minimum FP16 loss scale, after which training is stopped")
+    parser.add_argument("--threshold-loss-scale", type=float,
+                        help="threshold FP16 loss scale from below")
+    parser.add_argument("--user-dir", default=None,
+                        help="path to a python module containing custom tasks/models/losses")
+    parser.add_argument("--empty-cache-freq", default=0, type=int,
+                        help="(compat) how often to clear the device cache; no-op under XLA")
+    parser.add_argument("--all-gather-list-size", default=16384, type=int,
+                        help="number of bytes reserved for gathering stats from workers")
+    parser.add_argument("--suppress-crashes", action="store_true",
+                        help="suppress crashes when training with the entry point so that the "
+                             "main method can return a value (useful for sweeps)")
+    parser.add_argument("--profile", action="store_true",
+                        help="enable jax.profiler trace collection during training")
+    parser.add_argument("--ema-decay", default=-1.0, type=float,
+                        help="enable moving average for model parameters")
+    parser.add_argument("--validate-with-ema", action="store_true")
+    parser.add_argument("--debug-nans", action="store_true",
+                        help="enable jax_debug_nans to localize the first NaN-producing op")
+
+    from unicore_tpu.tasks import TASK_REGISTRY
+    parser.add_argument("--task", metavar="TASK", default=default_task,
+                        choices=TASK_REGISTRY.keys(), help="task")
+
+    # Add *--<registry>* flags (optimizer / lr-scheduler / loss).
+    for registry_name, REGISTRY in REGISTRIES.items():
+        parser.add_argument(
+            "--" + registry_name.replace("_", "-"),
+            default=REGISTRY["default"],
+            choices=REGISTRY["registry"].keys(),
+        )
+    return parser
+
+
+def add_dataset_args(parser, train=False, gen=False):
+    group = parser.add_argument_group("dataset_data_loading")
+    group.add_argument("--num-workers", default=1, type=int, metavar="N",
+                       help="how many subprocesses to use for data loading")
+    group.add_argument("--skip-invalid-size-inputs-valid-test", action="store_true",
+                       help="ignore too long or too short lines in valid and test set")
+    group.add_argument("--batch-size", "--max-sentences", type=int, metavar="N",
+                       help="maximum number of sentences in a batch")
+    group.add_argument("--required-batch-size-multiple", default=1, type=int, metavar="N",
+                       help="batch size will be a multiplier of this value")
+    group.add_argument("--data-buffer-size", default=10, type=int, metavar="N",
+                       help="number of batches to preload / double-buffer onto device")
+    if train:
+        group.add_argument("--train-subset", default="train", metavar="SPLIT",
+                           help="data subset to use for training (e.g. train, valid, test)")
+        group.add_argument("--valid-subset", default="valid", metavar="SPLIT",
+                           help="comma separated list of data subsets to use for validation")
+        group.add_argument("--validate-interval", type=int, default=1, metavar="N",
+                           help="validate every N epochs")
+        group.add_argument("--validate-interval-updates", type=int, default=0, metavar="N",
+                           help="validate every N updates")
+        group.add_argument("--validate-after-updates", type=int, default=0, metavar="N",
+                           help="dont validate until reaching this many updates")
+        group.add_argument("--fixed-validation-seed", default=None, type=int, metavar="N",
+                           help="specified random seed for validation")
+        group.add_argument("--disable-validation", action="store_true",
+                           help="disable validation")
+        group.add_argument("--batch-size-valid", type=int, metavar="N",
+                           help="maximum number of sentences in a validation batch")
+        group.add_argument("--max-valid-steps", "--nval", type=int, metavar="N",
+                           help="How many batches to evaluate")
+        group.add_argument("--curriculum", default=0, type=int, metavar="N",
+                           help="don't shuffle batches for first N epochs")
+    return group
+
+
+def add_distributed_training_args(parser, default_world_size=None):
+    group = parser.add_argument_group("distributed_training")
+    group.add_argument("--distributed-world-size", type=int, metavar="N",
+                       default=default_world_size,
+                       help="total number of devices across all hosts (default: all visible)")
+    group.add_argument("--distributed-rank", default=0, type=int,
+                       help="rank of the current host process")
+    group.add_argument("--distributed-backend", default="xla", type=str,
+                       help="distributed backend (XLA collectives over ICI/DCN)")
+    group.add_argument("--distributed-init-method", default=None, type=str,
+                       help="coordinator address for jax.distributed.initialize "
+                            "(e.g. host0:1234); inferred from env when unset")
+    group.add_argument("--distributed-port", default=-1, type=int,
+                       help="port number for the coordinator")
+    group.add_argument("--device-id", "--local_rank", default=0, type=int,
+                       help="process index on the current host")
+    group.add_argument("--distributed-no-spawn", action="store_true",
+                       help="(compat) single-process-per-host is the JAX default")
+    group.add_argument("--ddp-backend", default="c10d", type=str,
+                       choices=["c10d", "apex", "no_c10d", "legacy_ddp"],
+                       help="(compat) gradient sync strategy; all map to XLA SPMD psum")
+    group.add_argument("--bucket-cap-mb", default=25, type=int, metavar="MB",
+                       help="(compat) bucket size for reduction; XLA schedules collectives")
+    group.add_argument("--fix-batches-to-gpus", action="store_true",
+                       help="don't shuffle batches between epochs/shards")
+    group.add_argument("--find-unused-parameters", default=False, action="store_true",
+                       help="(compat) no-op: XLA SPMD has no unused-parameter problem")
+    group.add_argument("--fast-stat-sync", default=False, action="store_true",
+                       help="sum-reduce logging outputs on device instead of host gather")
+    group.add_argument("--broadcast-buffers", default=False, action="store_true",
+                       help="(compat) buffers are part of the replicated state pytree")
+    group.add_argument("--nprocs-per-node", type=int, metavar="N", default=None,
+                       help="(compat) devices per host; discovered by JAX")
+    # TPU-native mesh controls (no reference equivalent: new capability).
+    group.add_argument("--data-parallel-size", type=int, default=-1, metavar="N",
+                       help="size of the 'data' mesh axis (-1 = all remaining devices)")
+    group.add_argument("--model-parallel-size", type=int, default=1, metavar="N",
+                       help="size of the 'model' (tensor-parallel) mesh axis")
+    group.add_argument("--seq-parallel-size", type=int, default=1, metavar="N",
+                       help="size of the 'seq' (sequence/context-parallel) mesh axis")
+    group.add_argument("--pipeline-parallel-size", type=int, default=1, metavar="N",
+                       help="size of the 'pipe' (pipeline-parallel) mesh axis")
+    group.add_argument("--expert-parallel-size", type=int, default=1, metavar="N",
+                       help="size of the 'expert' mesh axis for MoE layers")
+    group.add_argument("--zero-shard-optimizer", action="store_true",
+                       help="shard fp32 master params + optimizer state over the data axis (ZeRO-1)")
+    return group
+
+
+def add_optimization_args(parser):
+    group = parser.add_argument_group("optimization")
+    group.add_argument("--max-epoch", "--me", default=0, type=int, metavar="N",
+                       help="force stop training at specified epoch")
+    group.add_argument("--max-update", "--mu", default=0, type=int, metavar="N",
+                       help="force stop training at specified update")
+    group.add_argument("--stop-time-hours", default=0, type=float, metavar="N",
+                       help="force stop training after specified cumulative time")
+    group.add_argument("--clip-norm", default=0.0, type=float, metavar="NORM",
+                       help="clip threshold of gradients")
+    group.add_argument("--per-sample-clip-norm", default=0.0, type=float, metavar="PNORM",
+                       help="clip threshold of gradients, before gradient sync over workers")
+    group.add_argument("--update-freq", default="1", metavar="N1,N2,...,N_K",
+                       type=lambda uf: utils.eval_str_list(uf, type=int),
+                       help="update parameters every N_i batches, when in epoch i")
+    group.add_argument("--lr", "--learning-rate", default="0.25",
+                       type=lambda x: utils.eval_str_list(x, type=float),
+                       metavar="LR_1,LR_2,...,LR_N",
+                       help="learning rate for the first N epochs; all epochs >N use LR_N")
+    group.add_argument("--stop-min-lr", default=-1, type=float, metavar="LR",
+                       help="stop training when the learning rate reaches this minimum")
+    return group
+
+
+def add_checkpoint_args(parser):
+    group = parser.add_argument_group("checkpoint")
+    group.add_argument("--save-dir", metavar="DIR", default="checkpoints",
+                       help="path to save checkpoints")
+    group.add_argument("--tmp-save-dir", metavar="DIR", default="./",
+                       help="fast local dir to save checkpoints before async copy to --save-dir")
+    group.add_argument("--restore-file", default="checkpoint_last.pt",
+                       help="filename from which to load checkpoint")
+    group.add_argument("--finetune-from-model", default=None, type=str,
+                       help="finetune from a pretrained model; resets optimizer, lr scheduler, "
+                            "meters and dataloader")
+    group.add_argument("--load-from-ema", action="store_true",
+                       help="initialize model params from the EMA state in the checkpoint")
+    group.add_argument("--reset-dataloader", action="store_true",
+                       help="don't restore the dataloader position from the checkpoint")
+    group.add_argument("--reset-lr-scheduler", action="store_true",
+                       help="don't restore lr scheduler state from the checkpoint")
+    group.add_argument("--reset-meters", action="store_true",
+                       help="don't restore metrics meters from the checkpoint")
+    group.add_argument("--reset-optimizer", action="store_true",
+                       help="don't restore optimizer state from the checkpoint")
+    group.add_argument("--optimizer-overrides", default="{}", type=str, metavar="DICT",
+                       help="a dictionary used to override optimizer args when loading a checkpoint")
+    group.add_argument("--save-interval", type=int, default=1, metavar="N",
+                       help="save a checkpoint every N epochs")
+    group.add_argument("--save-interval-updates", type=int, default=0, metavar="N",
+                       help="save a checkpoint (and validate) every N updates")
+    group.add_argument("--keep-interval-updates", type=int, default=-1, metavar="N",
+                       help="keep the last N checkpoints saved with --save-interval-updates")
+    group.add_argument("--keep-last-epochs", type=int, default=-1, metavar="N",
+                       help="keep last N epoch checkpoints")
+    group.add_argument("--keep-best-checkpoints", type=int, default=-1, metavar="N",
+                       help="keep best N checkpoints based on scores")
+    group.add_argument("--no-save", action="store_true",
+                       help="don't save models or checkpoints")
+    group.add_argument("--no-epoch-checkpoints", action="store_true",
+                       help="only store last and best checkpoints")
+    group.add_argument("--no-last-checkpoints", action="store_true",
+                       help="don't store last checkpoints")
+    group.add_argument("--no-save-optimizer-state", action="store_true",
+                       help="don't save optimizer-state as part of checkpoint")
+    group.add_argument("--best-checkpoint-metric", type=str, default="loss",
+                       help='metric to use for saving "best" checkpoints')
+    group.add_argument("--maximize-best-checkpoint-metric", action="store_true",
+                       help='select the largest metric value for saving "best" checkpoints')
+    group.add_argument("--patience", type=int, default=-1, metavar="N",
+                       help="early stop training if valid performance doesn't improve for N "
+                            "consecutive validation runs")
+    group.add_argument("--checkpoint-suffix", type=str, default="",
+                       help="suffix to add to the checkpoint file name")
+    group.add_argument("--async-checkpoint", type=utils.str_to_bool, default=True,
+                       help="write checkpoints on a background thread")
+    return group
+
+
+def add_common_eval_args(group):
+    group.add_argument("--path", metavar="FILE",
+                       help="path(s) to model file(s), colon separated")
+    group.add_argument("--quiet", action="store_true", help="only print final scores")
+    group.add_argument("--model-overrides", default="{}", type=str, metavar="DICT",
+                       help="a dictionary used to override model args at generation")
+    group.add_argument("--results-path", metavar="RESDIR", type=str, default=None,
+                       help="path to save eval results")
+
+
+def add_model_args(parser):
+    group = parser.add_argument_group("Model configuration")
+    from unicore_tpu.models import ARCH_MODEL_REGISTRY
+    group.add_argument("--arch", "-a", metavar="ARCH",
+                       choices=ARCH_MODEL_REGISTRY.keys(),
+                       help="model architecture")
+    return group

@@ -1,0 +1,22 @@
+from .mesh import (  # noqa
+    ALL_AXES,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    batch_sharding,
+    batch_spec,
+    get_global_mesh,
+    make_mesh,
+    make_mesh_from_args,
+    replicated,
+    set_global_mesh,
+)
+from .sharding import (  # noqa
+    DEFAULT_TP_RULES,
+    named,
+    param_spec,
+    params_pspecs,
+    zero1_pspecs,
+)

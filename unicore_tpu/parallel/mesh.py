@@ -1,0 +1,94 @@
+"""Device-mesh construction and sharding presets.
+
+No reference equivalent — the reference is DP-only over NCCL process groups
+(/root/reference/unicore/distributed/utils.py:203-233).  Here the mesh is the
+single source of truth for every parallelism axis, designed day-1 for
+(data, fsdp-style param sharding, tensor, sequence, pipeline, expert):
+
+    axes: ('data', 'model', 'seq', 'pipe', 'expert')  — unused axes size 1
+
+XLA lays device order so that the innermost axes ride ICI; DCN carries the
+outer (data) axis on multi-slice topologies.
+"""
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
+
+_global_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    seq: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    ``data=-1`` absorbs all remaining devices.  Axis order is
+    (data, expert, pipe, seq, model): the model/seq axes are innermost so
+    tensor- and sequence-parallel collectives map onto the fastest ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    fixed = model * seq * pipe * expert
+    if data == -1:
+        assert n % fixed == 0, (
+            f"device count {n} not divisible by model*seq*pipe*expert={fixed}"
+        )
+        data = n // fixed
+    assert data * fixed == n, (
+        f"mesh {data}x{expert}x{pipe}x{seq}x{model} != {n} devices"
+    )
+    dev_array = np.asarray(devices).reshape(data, expert, pipe, seq, model)
+    return Mesh(dev_array, (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def make_mesh_from_args(args, devices=None) -> Mesh:
+    return make_mesh(
+        data=getattr(args, "data_parallel_size", -1) or -1,
+        model=getattr(args, "model_parallel_size", 1),
+        seq=getattr(args, "seq_parallel_size", 1),
+        pipe=getattr(args, "pipeline_parallel_size", 1),
+        expert=getattr(args, "expert_parallel_size", 1),
+        devices=devices,
+    )
+
+
+def set_global_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def batch_spec() -> P:
+    """Batch arrays: sharded over (data, seq if used) on the leading dims."""
+    return P((DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,106 @@
+"""Parameter-sharding rules (pjit partition specs).
+
+Replaces the reference's DDP wrapper selection
+(/root/reference/unicore/models/distributed_unicore_model.py:37-63) — on TPU
+there is no wrapper: state lives as sharded jax.Arrays and XLA inserts the
+collectives.  ``--ddp-backend`` maps to a preset:
+
+    c10d / apex / no_c10d / legacy_ddp -> 'replicated' (pure DP, grads psum'd)
+    + --zero-shard-optimizer           -> fp32 master/opt state sharded over
+                                          'data' (ZeRO-1)
+    + --model-parallel-size > 1        -> 2D megatron-style tensor sharding
+                                          by param-name rules
+"""
+
+import logging
+import re
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+logger = logging.getLogger(__name__)
+
+
+# Megatron-style rules: column-parallel for up-projections / qkv, row-parallel
+# for down-projections.  Matched against the '/'-joined param path.
+DEFAULT_TP_RULES = [
+    # attention qkv / in_proj: shard output features
+    (r".*(q_proj|k_proj|v_proj|in_proj|qkv).*kernel", P(None, MODEL_AXIS)),
+    (r".*(q_proj|k_proj|v_proj|in_proj|qkv).*bias", P(MODEL_AXIS)),
+    # attention output projection: shard input features
+    (r".*(out_proj|o_proj).*kernel", P(MODEL_AXIS, None)),
+    # MLP up: shard output features
+    (r".*(fc1|up_proj|gate_proj|wi).*kernel", P(None, MODEL_AXIS)),
+    (r".*(fc1|up_proj|gate_proj|wi).*bias", P(MODEL_AXIS)),
+    # MLP down: shard input features
+    (r".*(fc2|down_proj|wo).*kernel", P(MODEL_AXIS, None)),
+    # embeddings: shard vocab dim
+    (r".*embed_tokens.*embedding", P(MODEL_AXIS, None)),
+]
+
+
+def param_spec(path: str, shape, rules=None) -> P:
+    """Partition spec for one parameter by path-rule matching."""
+    rules = DEFAULT_TP_RULES if rules is None else rules
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path):
+            # only apply if rank matches and dims divide later at pjit time
+            if len([s for s in spec if s is not None]) <= len(shape):
+                return spec
+    return P()
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def params_pspecs(params, use_tp: bool = False, rules=None):
+    """PartitionSpec pytree for a parameter pytree.
+
+    Pure DP: everything replicated.  With ``use_tp``, apply the megatron
+    rules.  The result feeds jit in/out shardings; gradient psums over the
+    data axis are then emitted by XLA automatically.
+    """
+
+    def spec_for(path, leaf):
+        if not use_tp:
+            return P()
+        return param_spec(_path_str(path), leaf.shape, rules)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def zero1_pspecs(params, mesh: Mesh):
+    """ZeRO-1: shard fp32 master params / optimizer moments over the data
+    axis along each leaf's largest divisible dim (optional capability beyond
+    the reference, SURVEY.md §2.3)."""
+    ndata = mesh.shape[DATA_AXIS]
+
+    def spec_for(leaf):
+        for dim, size in enumerate(leaf.shape):
+            if size % ndata == 0 and size >= ndata:
+                spec = [None] * leaf.ndim
+                spec[dim] = DATA_AXIS
+                return P(*spec)
+        return P()
+
+    return jax.tree_util.tree_map(spec_for, params)
+
+
+def named(mesh: Mesh, pspecs):
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,80 @@
+"""Generic registry engine.
+
+Capability parity with the reference registry system
+(/root/reference/unicore/registry.py:13-81): each registry owns a ``--<name>``
+CLI choice flag, a decorator to register implementations, and a ``build_x``
+that injects the registered class's argparse defaults into the args namespace
+before construction.  Re-designed as a plain-Python component (no torch / no
+device deps) shared by optimizers, LR schedulers, losses, tasks and models.
+"""
+
+import argparse
+
+REGISTRIES = {}
+
+
+def setup_registry(registry_name: str, base_class=None, default=None, required=False):
+    assert registry_name.startswith("--")
+    registry_name = registry_name[2:].replace("-", "_")
+
+    REGISTRY = {}
+    REGISTRY_CLASS_NAMES = set()
+
+    # maintain a registry of all registries
+    if registry_name in REGISTRIES:
+        raise ValueError(f"Cannot setup duplicate registry: {registry_name}")
+    REGISTRIES[registry_name] = {"registry": REGISTRY, "default": default}
+
+    def build_x(args, *extra_args, **extra_kwargs):
+        choice = getattr(args, registry_name, None)
+        if choice is None:
+            return None
+        cls = REGISTRY[choice]
+        if hasattr(cls, "build_" + registry_name):
+            builder = getattr(cls, "build_" + registry_name)
+        else:
+            builder = cls
+        set_defaults(args, cls)
+        return builder(args, *extra_args, **extra_kwargs)
+
+    def register_x(name):
+        def register_x_cls(cls):
+            if name in REGISTRY:
+                raise ValueError(
+                    f"Cannot register duplicate {registry_name} ({name})"
+                )
+            if cls.__name__ in REGISTRY_CLASS_NAMES:
+                raise ValueError(
+                    f"Cannot register {registry_name} with duplicate class name "
+                    f"({cls.__name__})"
+                )
+            if base_class is not None and not issubclass(cls, base_class):
+                raise ValueError(
+                    f"{registry_name} must extend {base_class.__name__}"
+                )
+            REGISTRY[name] = cls
+            REGISTRY_CLASS_NAMES.add(cls.__name__)
+            return cls
+
+        return register_x_cls
+
+    return build_x, register_x, REGISTRY
+
+
+def set_defaults(args, cls):
+    """Inject the class's argparse defaults into *args* for any unset attr."""
+    if not hasattr(cls, "add_args"):
+        return
+    parser = argparse.ArgumentParser(
+        argument_default=argparse.SUPPRESS, allow_abbrev=False
+    )
+    cls.add_args(parser)
+    defaults = argparse.Namespace()
+    for action in parser._actions:
+        if action.dest is not argparse.SUPPRESS:
+            if not hasattr(defaults, action.dest):
+                if action.default is not argparse.SUPPRESS:
+                    setattr(defaults, action.dest, action.default)
+    for key, default_value in vars(defaults).items():
+        if not hasattr(args, key):
+            setattr(args, key, default_value)

@@ -1,0 +1,53 @@
+"""Task registry (reference /root/reference/unicore/tasks/__init__.py:16-86)."""
+
+import argparse
+import importlib
+import os
+
+from .unicore_task import UnicoreTask
+
+TASK_REGISTRY = {}
+TASK_CLASS_NAMES = set()
+
+
+def setup_task(args, **kwargs):
+    return TASK_REGISTRY[args.task].setup_task(args, **kwargs)
+
+
+def register_task(name):
+    """Decorator registering a :class:`UnicoreTask` subclass by name."""
+
+    def register_task_cls(cls):
+        if name in TASK_REGISTRY:
+            raise ValueError(f"Cannot register duplicate task ({name})")
+        if not issubclass(cls, UnicoreTask):
+            raise ValueError(
+                f"Task ({name}: {cls.__name__}) must extend UnicoreTask"
+            )
+        if cls.__name__ in TASK_CLASS_NAMES:
+            raise ValueError(
+                f"Cannot register task with duplicate class name ({cls.__name__})"
+            )
+        TASK_REGISTRY[name] = cls
+        TASK_CLASS_NAMES.add(cls.__name__)
+        return cls
+
+    return register_task_cls
+
+
+def get_task(name):
+    return TASK_REGISTRY[name]
+
+
+# Auto-import bundled tasks.
+tasks_dir = os.path.dirname(__file__)
+for file in sorted(os.listdir(tasks_dir)):
+    path = os.path.join(tasks_dir, file)
+    if (
+        not file.startswith("_")
+        and not file.startswith(".")
+        and (file.endswith(".py") or os.path.isdir(path))
+        and file != "unicore_task.py"
+    ):
+        task_name = file[: file.find(".py")] if file.endswith(".py") else file
+        importlib.import_module("unicore_tpu.tasks." + task_name)

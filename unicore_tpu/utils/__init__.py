@@ -1,0 +1,272 @@
+"""General utilities.
+
+Capability parity with /root/reference/unicore/utils.py, re-designed for JAX:
+sample tree-mapping, host<->device movement, global grad-norm + clipping (one
+fused XLA reduction replaces the multi-tensor-apply CUDA kernel at
+utils.py:87-135), ``--user-dir`` plugin import (utils.py:138-171), activation
+functions, seeding helpers, and the Uni-Fold tensor helpers
+(permute_final_dims / flatten_final_dims / masked_mean / one_hot /
+batched_gather, utils.py:336-411).
+"""
+
+import contextlib
+import importlib
+import os
+import sys
+import warnings
+from functools import partial
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# sample / pytree helpers (reference utils.py:43-84)
+# ---------------------------------------------------------------------------
+
+def apply_to_sample(f, sample):
+    """Apply ``f`` to every array leaf in a (possibly nested) sample."""
+    if hasattr(sample, "__len__") and len(sample) == 0:
+        return {}
+
+    def _apply(x):
+        if isinstance(x, (np.ndarray, jnp.ndarray)):
+            return f(x)
+        elif isinstance(x, dict):
+            return {key: _apply(value) for key, value in x.items()}
+        elif isinstance(x, list):
+            return [_apply(x) for x in x]
+        elif isinstance(x, tuple):
+            return tuple(_apply(x) for x in x)
+        elif isinstance(x, set):
+            return {_apply(x) for x in x}
+        else:
+            return x
+
+    return _apply(sample)
+
+
+def move_to_device(sample, sharding=None):
+    """Host->device transfer (replaces move_to_cuda, reference utils.py:61-71).
+
+    With a ``sharding`` (e.g. ``NamedSharding(mesh, P('data'))``) the batch is
+    laid out SPMD-style across the mesh in one transfer.
+    """
+
+    def _move(x):
+        x = jnp.asarray(x)
+        if sharding is not None:
+            return jax.device_put(x, sharding)
+        return x
+
+    return apply_to_sample(_move, sample)
+
+
+def move_to_cpu(sample):
+    return apply_to_sample(lambda x: np.asarray(jax.device_get(x)), sample)
+
+
+def tensor_tree_map(fn, tree):
+    """Reference utils.py:404-411 — jax.tree_util does this natively."""
+    return jax.tree_util.tree_map(fn, tree)
+
+
+# ---------------------------------------------------------------------------
+# grad norm / clipping (reference utils.py:87-135)
+# ---------------------------------------------------------------------------
+
+def total_norm(tree, dtype=jnp.float32):
+    """Global L2 norm over a pytree as ONE fused XLA reduction.
+
+    TPU-native replacement for the ``unicore_fused_multi_tensor.l2norm``
+    multi-tensor-apply CUDA kernel (reference utils.py:87-107): XLA fuses the
+    per-leaf square-sums into a single kernel, so no multi-launch problem
+    exists to solve.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), dtype=dtype)
+    sq = sum(jnp.sum(jnp.square(x.astype(dtype))) for x in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_grad_norm(grads, max_norm: float, eps: float = 1e-6):
+    """Clip a grad pytree to ``max_norm`` (reference utils.py:110-135).
+
+    Returns ``(clipped_grads, grad_norm)``.  Branchless (jit-safe): when
+    ``max_norm <= 0`` the scale is 1.
+    """
+    gnorm = total_norm(grads)
+    max_norm = jnp.asarray(max_norm, dtype=gnorm.dtype)
+    clip_coef = jnp.where(
+        max_norm > 0, jnp.minimum(max_norm / (gnorm + eps), 1.0), 1.0
+    )
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads
+    )
+    return clipped, gnorm
+
+
+# ---------------------------------------------------------------------------
+# user-dir plugin import (reference utils.py:138-171)
+# ---------------------------------------------------------------------------
+
+def import_user_module(args):
+    module_path = getattr(args, "user_dir", None)
+    if module_path is None:
+        return
+    module_path = os.path.abspath(args.user_dir)
+    if not os.path.exists(module_path):
+        unicore_rel_path = os.path.join(os.path.dirname(__file__), "..", args.user_dir)
+        if os.path.exists(unicore_rel_path):
+            module_path = unicore_rel_path
+    module_parent, module_name = os.path.split(module_path)
+    if module_name not in sys.modules:
+        sys.path.insert(0, module_parent)
+        importlib.import_module(module_name)
+        sys.path.pop(0)
+
+
+# ---------------------------------------------------------------------------
+# activation functions (reference utils.py:174-195)
+# ---------------------------------------------------------------------------
+
+def get_activation_fn(activation: str) -> Callable:
+    if activation == "relu":
+        return jax.nn.relu
+    elif activation == "gelu":
+        return partial(jax.nn.gelu, approximate=False)
+    elif activation == "gelu_fast" or activation == "gelu_accurate":
+        return partial(jax.nn.gelu, approximate=True)
+    elif activation == "tanh":
+        return jnp.tanh
+    elif activation == "linear":
+        return lambda x: x
+    elif activation == "swish" or activation == "silu":
+        return jax.nn.silu
+    else:
+        raise RuntimeError(f"--activation-fn {activation} not supported")
+
+
+# ---------------------------------------------------------------------------
+# RNG helpers (reference utils.py:206-242 torch_seed ctx -> fold_in chains)
+# ---------------------------------------------------------------------------
+
+def make_step_rng(seed: int, *folds: int) -> jax.Array:
+    """Deterministic per-(step, micro-batch, rank, ...) RNG key.
+
+    Replaces the reference's ``torch_seed(seed, step, i, rank)`` context
+    (trainer.py:602-607): fold each coordinate into the base key so every
+    (update, micro-batch, data-shard) triple has a decorrelated dropout
+    stream that is reproducible across restarts.
+    """
+    key = jax.random.PRNGKey(seed)
+    for f in folds:
+        key = jax.random.fold_in(key, f)
+    return key
+
+
+# ---------------------------------------------------------------------------
+# Uni-Fold tensor helpers (reference utils.py:336-411)
+# ---------------------------------------------------------------------------
+
+def permute_final_dims(tensor, inds: List[int]):
+    zero_index = -1 * len(inds)
+    first_inds = list(range(tensor.ndim + zero_index))
+    return jnp.transpose(tensor, first_inds + [zero_index + i for i in inds])
+
+
+def flatten_final_dims(t, num_dims: int):
+    return t.reshape(t.shape[:-num_dims] + (-1,))
+
+
+def masked_mean(mask, value, dim, eps=1e-10):
+    mask = mask.astype(value.dtype)
+    return jnp.sum(mask * value, axis=dim) / (eps + jnp.sum(mask, axis=dim))
+
+
+def one_hot(x, num_classes, dtype=jnp.float32):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def batched_gather(data, inds, dim=0, num_batch_dims=0):
+    assert dim < 0 or dim - num_batch_dims >= 0
+    ranges = []
+    for i, s in enumerate(data.shape[:num_batch_dims]):
+        r = jnp.arange(s)
+        r = r.reshape(*(*((1,) * i), -1, *((1,) * (len(inds.shape) - i - 1))))
+        ranges.append(r)
+    remaining_dims = [slice(None) for _ in range(len(data.shape) - num_batch_dims)]
+    remaining_dims[dim - num_batch_dims if dim >= 0 else dim] = inds
+    ranges.extend(remaining_dims)
+    return data[tuple(ranges)]
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def item(x):
+    """Fetch a scalar to host (replaces tensor.item())."""
+    if hasattr(x, "item"):
+        return x.item()
+    return x
+
+
+def has_parameters(module) -> bool:
+    try:
+        next(iter(jax.tree_util.tree_leaves(module)))
+        return True
+    except StopIteration:
+        return False
+
+
+def eval_str_list(x, type=float):
+    if x is None:
+        return None
+    if isinstance(x, str):
+        x = eval(x)
+    try:
+        return list(map(type, x))
+    except TypeError:
+        return [type(x)]
+
+
+def eval_bool(x, default=False):
+    if x is None:
+        return default
+    try:
+        return bool(eval(x))
+    except TypeError:
+        return default
+
+
+def str_to_bool(x):
+    if isinstance(x, bool):
+        return x
+    return str(x).lower() in ("yes", "true", "t", "1")
+
+
+def csv_str_list(x):
+    if x is None:
+        return None
+    return x.split(",")
+
+
+def get_device_memory_info() -> Dict[str, float]:
+    """Per-device memory stats (replaces CudaEnvironment, utils.py:245-271)."""
+    out = {}
+    try:
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if stats:
+                out[str(d)] = {
+                    "bytes_in_use": stats.get("bytes_in_use", 0),
+                    "bytes_limit": stats.get("bytes_limit", 0),
+                }
+    except Exception:
+        pass
+    return out
