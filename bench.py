@@ -1,0 +1,121 @@
+#!/usr/bin/env python3
+"""Headline benchmark: BERT-base MLM training throughput (samples/sec/chip).
+
+Runs the REAL training path — the Trainer's fused jitted step (forward,
+backward, clip, Adam, EMA) — on whatever accelerator JAX sees (the axon TPU
+chip in this environment; no platform override here).  Config mirrors the
+reference's de-facto perf config (examples/bert/train_bert_test.sh: BERT-base,
+Adam (0.9, 0.98), seq 512) in bf16, batch size chosen for one v5e chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is null — the reference publishes no numbers (BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+from argparse import Namespace
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "32"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    vocab = 30522
+    warmup, iters = 3, 10
+
+    args = Namespace(
+        seed=1,
+        bf16=True,
+        fp16=False,
+        bf16_sr=False,
+        allreduce_fp32_grad=False,
+        fp16_init_scale=4,
+        fp16_scale_window=None,
+        min_loss_scale=1e-4,
+        clip_norm=1.0,
+        per_sample_clip_norm=0.0,
+        data_parallel_size=-1,
+        model_parallel_size=1,
+        seq_parallel_size=1,
+        pipeline_parallel_size=1,
+        expert_parallel_size=1,
+        zero_shard_optimizer=False,
+        optimizer="adam",
+        lr_scheduler="fixed",
+        lr=[1e-4],
+        adam_betas="(0.9, 0.98)",
+        adam_eps=1e-6,
+        weight_decay=1e-4,
+        force_anneal=None,
+        lr_shrink=0.1,
+        warmup_updates=0,
+        ema_decay=-1.0,
+        validate_with_ema=False,
+        max_update=10_000,
+        update_freq=[1],
+    )
+
+    class _BenchTask(UnicoreTask):
+        class _Dict:
+            def pad(self):
+                return 1
+
+        dictionary = _Dict()
+
+    task = _BenchTask(args)
+    model = BertModel(
+        vocab_size=vocab,
+        padding_idx=1,
+        encoder_layers=12,
+        encoder_embed_dim=768,
+        encoder_ffn_embed_dim=3072,
+        encoder_attention_heads=12,
+        max_seq_len=seq_len,
+        post_ln=True,
+    )
+    loss = LOSS_REGISTRY["masked_lm"](task)
+    trainer = Trainer(args, task, model, loss)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(4, vocab, size=(batch_size, seq_len)).astype(np.int64)
+    target = np.where(rng.rand(batch_size, seq_len) < 0.15, tokens, 1).astype(
+        np.int64
+    )
+    sample = {"net_input": {"src_tokens": tokens}, "target": target}
+
+    for _ in range(warmup):
+        out = trainer.train_step([sample])
+    jax.block_until_ready(trainer.state["params"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = trainer.train_step([sample])
+    jax.block_until_ready(trainer.state["params"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    samples_per_sec_per_chip = batch_size * iters / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_mlm_bf16_seq512_samples_per_sec_per_chip",
+                "value": round(samples_per_sec_per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

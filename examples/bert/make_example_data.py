@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Build a tiny self-contained BERT MLM dataset (no network needed).
+
+The reference example packs wikitext-2 into LMDB
+(/root/reference/examples/bert/example_data/preprocess.py); this environment
+has no egress, so we synthesize a small natural-ish corpus and write it into
+the framework's native indexed shard format plus a WordPiece-compatible
+dict.txt (plain vocab list — Dictionary.load accepts count-less lines).
+
+Usage: python make_example_data.py [out_dir] [n_train] [n_valid]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from unicore_tpu.data.indexed_dataset import make_builder  # noqa: E402
+
+WORDS = (
+    "the of and to in a is that for it as was with be by on not he i this are "
+    "or his from at which but have an they you were her she all would there "
+    "been one their we him two has when who will more no if out so said what "
+    "up its about into than them can only other new some could time these may "
+    "then do first any my now such like our over man me even most made after "
+    "also did many before must through years where much your way well down "
+    "should because each just those people how too little state good very "
+    "make world still own see men work long get here between both life being "
+    "under never day same another know while last might us great old year off "
+    "come since against go came right used take three small large molecule "
+    "protein structure energy atom bond model train learn deep network"
+).split()
+
+
+def make_sentence(rng, lo=8, hi=48):
+    n = rng.randint(lo, hi)
+    return " ".join(rng.choice(WORDS, size=n))
+
+
+def main():
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(__file__), "example_data"
+    )
+    n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 2000
+    n_valid = int(sys.argv[3]) if len(sys.argv) > 3 else 200
+    os.makedirs(out_dir, exist_ok=True)
+
+    # WordPiece vocab: specials + whole words + a few continuation pieces
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    vocab += sorted(set(WORDS))
+    vocab += ["##s", "##ing", "##ed", "##er"]
+    with open(os.path.join(out_dir, "dict.txt"), "w") as f:
+        f.write("\n".join(vocab) + "\n")
+
+    rng = np.random.RandomState(42)
+    for split, n in [("train", n_train), ("valid", n_valid)]:
+        builder = make_builder(os.path.join(out_dir, split))
+        for _ in range(n):
+            builder.add_item(make_sentence(rng))
+        builder.finalize()
+        print(f"wrote {n} sentences to {out_dir}/{split}.bin")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,415 @@
+"""Checkpoint management
+(reference /root/reference/unicore/checkpoint_utils.py).
+
+Same capability surface: save-condition matrix (epoch / N-updates / best /
+last), regex-driven retention pruning, atomic tmp+rename writes staged in
+``--tmp-save-dir`` with an async copy thread to ``--save-dir``,
+``--finetune-from-model`` reset semantics, writability probe.
+
+Format: pickled dict whose array leaves are numpy (device arrays are
+gathered with ``jax.device_get`` before save) — torch-free, readable from
+any host.  A one-way torch ``.pt`` -> pytree converter is provided for
+importing Uni-Core / Uni-Mol weights (SURVEY.md §7 'checkpoint interop').
+"""
+
+import ast
+import collections
+import logging
+import os
+import pickle
+import re
+import shutil
+import traceback
+from multiprocessing.pool import ThreadPool
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# async copy + retention (reference ckp_copy_fun, checkpoint_utils.py:23-80)
+# ---------------------------------------------------------------------------
+
+def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
+    has_copy = False
+    can_delete = args.tmp_save_dir != args.save_dir
+    for cp in checkpoints:
+        try:
+            if src != cp:
+                logger.info(f"copy {src} to {cp}")
+                has_copy = True
+                shutil.copyfile(src, cp)
+        except Exception:
+            logger.info("copy failed, please copy it manually")
+
+    try:
+        if can_delete and has_copy and os.path.lexists(src):
+            logger.info(f"removing temp file {src} ...")
+            os.remove(src)
+
+        def remove_ckps(root_path):
+            if not end_of_epoch and args.keep_interval_updates > 0:
+                # checkpoints are sorted in descending order
+                ckps = checkpoint_paths(
+                    root_path, pattern=r"checkpoint_\d+_(\d+)\.pt"
+                )
+                for old_chk in ckps[args.keep_interval_updates:]:
+                    if os.path.lexists(old_chk):
+                        os.remove(old_chk)
+                        logger.info(f"removed {old_chk}")
+
+            if args.keep_last_epochs >= 0:
+                ckps = checkpoint_paths(root_path, pattern=r"checkpoint(\d+)\.pt")
+                for old_chk in ckps[args.keep_last_epochs:]:
+                    if os.path.lexists(old_chk):
+                        os.remove(old_chk)
+                        logger.info(f"removed {old_chk}")
+
+            if args.keep_best_checkpoints > 0:
+                ckps = checkpoint_paths(
+                    root_path,
+                    pattern=r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
+                        args.best_checkpoint_metric
+                    ),
+                )
+                if not args.maximize_best_checkpoint_metric:
+                    ckps = ckps[::-1]
+                for old_chk in ckps[args.keep_best_checkpoints:]:
+                    if os.path.lexists(old_chk):
+                        os.remove(old_chk)
+                        logger.info(f"removed {old_chk}")
+
+        remove_ckps(args.save_dir)
+    except Exception:
+        logger.info("remove old ckps error")
+
+    logger.info("finished async ckp saving.")
+
+
+# ---------------------------------------------------------------------------
+# save condition matrix (reference save_checkpoint, checkpoint_utils.py:83-162)
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
+                    do_save=True):
+    from unicore_tpu.logging import meters
+
+    # only one worker should attempt to create the required dir
+    if trainer.data_parallel_rank == 0:
+        os.makedirs(args.save_dir, exist_ok=True)
+        os.makedirs(args.tmp_save_dir, exist_ok=True)
+
+    prev_best = getattr(save_checkpoint, "best", val_loss)
+    if val_loss is not None:
+        best_function = max if args.maximize_best_checkpoint_metric else min
+        save_checkpoint.best = best_function(val_loss, prev_best)
+
+    if args.no_save or not do_save:
+        return
+
+    if not trainer.should_save_checkpoint_on_current_rank:
+        return
+
+    write_timer = meters.StopwatchMeter()
+    write_timer.start()
+
+    epoch = epoch_itr.epoch
+    end_of_epoch = epoch_itr.end_of_epoch()
+    updates = trainer.get_num_updates()
+
+    logger.info(f"Preparing to save checkpoint for epoch {epoch} @ {updates} updates")
+
+    def is_better(a, b):
+        return a >= b if args.maximize_best_checkpoint_metric else a <= b
+
+    suffix = trainer.checkpoint_suffix
+    checkpoint_conds = collections.OrderedDict()
+    checkpoint_conds[f"checkpoint{epoch}{suffix}.pt"] = (
+        end_of_epoch
+        and not args.no_epoch_checkpoints
+        and epoch % args.save_interval == 0
+    )
+    checkpoint_conds[f"checkpoint_{epoch}_{updates}{suffix}.pt"] = (
+        not end_of_epoch
+        and args.save_interval_updates > 0
+        and updates % args.save_interval_updates == 0
+    )
+    checkpoint_conds[f"checkpoint_best{suffix}.pt"] = val_loss is not None and (
+        not hasattr(save_checkpoint, "best")
+        or is_better(val_loss, save_checkpoint.best)
+    )
+    if val_loss is not None and args.keep_best_checkpoints > 0:
+        checkpoint_conds[
+            "checkpoint.best_{}_{:.2f}.pt".format(args.best_checkpoint_metric, val_loss)
+        ] = not hasattr(save_checkpoint, "best") or is_better(
+            val_loss, save_checkpoint.best
+        )
+    checkpoint_conds[f"checkpoint_last{suffix}.pt"] = not args.no_last_checkpoints
+
+    extra_state = {"train_iterator": epoch_itr.state_dict(), "val_loss": val_loss}
+    if hasattr(save_checkpoint, "best"):
+        extra_state.update({"best": save_checkpoint.best})
+
+    checkpoints = [
+        os.path.join(args.save_dir, fn) for fn, cond in checkpoint_conds.items() if cond
+    ]
+    tmp_checkpoints = [
+        os.path.join(args.tmp_save_dir, fn)
+        for fn, cond in checkpoint_conds.items()
+        if cond
+    ]
+    if len(checkpoints) > 0:
+        trainer.save_checkpoint(tmp_checkpoints[0], extra_state)
+        if ckp_copy_thread is not None:
+            ckp_copy_thread.apply_async(
+                ckp_copy_fun, (tmp_checkpoints[0], checkpoints, end_of_epoch, args)
+            )
+        else:
+            ckp_copy_fun(tmp_checkpoints[0], checkpoints, end_of_epoch, args)
+        write_timer.stop()
+        logger.info(
+            "Saved checkpoint {} (epoch {} @ {} updates, score {}) "
+            "(writing took {} seconds)".format(
+                tmp_checkpoints[0], epoch, updates, val_loss, write_timer.sum
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# load (reference load_checkpoint, checkpoint_utils.py:165-241)
+# ---------------------------------------------------------------------------
+
+def load_checkpoint(args, trainer, **passthrough_args):
+    """Load a checkpoint and restore the training iterator."""
+    reset_optimizer = args.reset_optimizer
+    reset_lr_scheduler = args.reset_lr_scheduler
+    optimizer_overrides = ast.literal_eval(args.optimizer_overrides)
+    reset_meters = args.reset_meters
+    reset_dataloader = args.reset_dataloader
+
+    if args.finetune_from_model is not None and (
+        reset_optimizer or reset_lr_scheduler or reset_meters or reset_dataloader
+    ):
+        raise ValueError(
+            "--finetune-from-model can not be set together with either "
+            "--reset-optimizer or reset_lr_scheduler or reset_meters or "
+            "reset_dataloader"
+        )
+
+    suffix = trainer.checkpoint_suffix
+    if args.restore_file == "checkpoint_last.pt":
+        checkpoint_path = os.path.join(args.save_dir, f"checkpoint_last{suffix}.pt")
+        first_launch = not os.path.exists(checkpoint_path)
+        if args.finetune_from_model is not None and first_launch:
+            # no last checkpoint: start finetune from the pretrained model
+            if os.path.exists(args.finetune_from_model):
+                checkpoint_path = args.finetune_from_model
+                reset_optimizer = True
+                reset_lr_scheduler = True
+                reset_meters = True
+                reset_dataloader = True
+                logger.info(
+                    f"loading pretrained model from {checkpoint_path}: "
+                    "optimizer, lr scheduler, meters, dataloader will be reset"
+                )
+            else:
+                raise ValueError(
+                    f"--finetune-from-model {args.finetune_from_model} does not exist"
+                )
+    elif suffix is not None and suffix != "":
+        checkpoint_path = args.restore_file.replace(".pt", suffix + ".pt")
+    else:
+        checkpoint_path = args.restore_file
+
+    if args.restore_file != "checkpoint_last.pt" and args.finetune_from_model:
+        raise ValueError(
+            "--finetune-from-model and --restore-file (non-default value) "
+            "can not be specified together: " + str(args)
+        )
+
+    extra_state = trainer.load_checkpoint(
+        checkpoint_path,
+        reset_optimizer,
+        reset_lr_scheduler,
+        reset_dataloader,
+        optimizer_overrides,
+        reset_meters=reset_meters,
+        **passthrough_args,
+    )
+
+    if (
+        extra_state is not None
+        and "best" in extra_state
+        and not reset_optimizer
+        and not reset_meters
+    ):
+        save_checkpoint.best = extra_state["best"]
+
+    if extra_state is not None and reset_dataloader:
+        extra_state.pop("train_iterator", None)
+
+    return extra_state
+
+
+def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
+    """Load a checkpoint into host memory (reference checkpoint_utils.py:244-258).
+
+    Transparently reads either this framework's pickle format or a torch
+    ``.pt`` checkpoint (converted on the fly via :func:`torch_to_pytree`).
+    """
+    with open(path, "rb") as f:
+        magic = f.read(2)
+    if magic == b"PK":  # torch >= 1.6 zipfile format
+        state = load_torch_checkpoint(path)
+    else:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+
+    if "args" in state and state["args"] is not None and arg_overrides is not None:
+        args = state["args"]
+        for arg_name, arg_val in arg_overrides.items():
+            setattr(args, arg_name, arg_val)
+    return state
+
+
+def load_torch_checkpoint(path):
+    """One-way torch .pt -> numpy-pytree converter (Uni-Core interop)."""
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=False)
+    return torch_to_pytree(state)
+
+
+def torch_to_pytree(obj):
+    try:
+        import torch
+
+        if isinstance(obj, torch.Tensor):
+            t = obj.detach().cpu()
+            if t.dtype == torch.bfloat16:
+                return t.float().numpy().astype("bfloat16")
+            return t.numpy()
+    except ImportError:
+        pass
+    if isinstance(obj, dict):
+        return {k: torch_to_pytree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(torch_to_pytree(v) for v in obj)
+    return obj
+
+
+def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):
+    """All checkpoints in `path` matching `pattern`, sorted descending by the
+    first regex group (reference checkpoint_utils.py:261-277)."""
+    pt_regexp = re.compile(pattern)
+    if not os.path.exists(path):
+        return []
+    files = os.listdir(path)
+    entries = []
+    for i, f in enumerate(files):
+        m = pt_regexp.fullmatch(f)
+        if m is not None:
+            idx = float(m.group(1)) if len(m.groups()) > 0 else i
+            entries.append((idx, m.group(0)))
+    return [os.path.join(path, x[1]) for x in sorted(entries, reverse=True)]
+
+
+def persistent_save(obj, filename):
+    """Atomic pickle save: tmp + rename, 3 retries
+    (reference torch_persistent_save, checkpoint_utils.py:280-297)."""
+    for i in range(3):
+        try:
+            with open(filename + ".tmp", "wb") as f:
+                pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.rename(filename + ".tmp", filename)
+            return
+        except Exception:
+            if i == 2:
+                logger.error(traceback.format_exc())
+
+
+def verify_checkpoint_directory(save_dir: str) -> None:
+    if not os.path.exists(save_dir):
+        os.makedirs(save_dir, exist_ok=True)
+    temp_file_path = os.path.join(save_dir, "dummy")
+    try:
+        with open(temp_file_path, "w"):
+            pass
+    except OSError as e:
+        logger.warning(f"Unable to access checkpoint save directory: {save_dir}")
+        raise e
+    else:
+        os.remove(temp_file_path)
+
+
+def make_copy_pool():
+    return ThreadPool(processes=1)
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> state-dict helpers
+# ---------------------------------------------------------------------------
+
+def to_numpy_tree(tree):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def merge_params(params, state_dict, strict=True):
+    """Copy checkpoint leaves into the current param pytree layout.
+
+    ``strict=True`` requires identical structure.  ``strict=False`` keeps
+    current values for missing leaves and ignores unexpected ones (torch
+    load_state_dict(strict=False) semantics on pytrees).
+    """
+    import jax
+
+    flat_params = _flatten_dict(params)
+    flat_ckpt = _flatten_dict(state_dict)
+    missing = [k for k in flat_params if k not in flat_ckpt]
+    unexpected = [k for k in flat_ckpt if k not in flat_params]
+    if strict and (missing or unexpected):
+        raise KeyError(
+            f"param mismatch loading checkpoint: missing={missing[:5]} "
+            f"unexpected={unexpected[:5]}"
+        )
+    if missing:
+        logger.warning(f"missing keys in checkpoint: {missing[:10]}...")
+    if unexpected:
+        logger.warning(f"unexpected keys in checkpoint: {unexpected[:10]}...")
+    merged = {}
+    for k, v in flat_params.items():
+        if k in flat_ckpt:
+            new = np.asarray(flat_ckpt[k])
+            if tuple(new.shape) != tuple(v.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: checkpoint {new.shape} vs model {v.shape}"
+                )
+            merged[k] = new.astype(v.dtype)
+        else:
+            merged[k] = v
+    return _unflatten_dict(merged)
+
+
+def _flatten_dict(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_dict(v, prefix + str(k) + "/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten_dict(flat):
+    out = {}
+    for k, v in flat.items():
+        parts = k.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out

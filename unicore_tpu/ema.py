@@ -1,0 +1,45 @@
+"""Exponential moving average of model parameters
+(reference /root/reference/unicore/ema.py).
+
+The reference keeps a deep-copied fp32 shadow model updated after each step
+(ema.py:26-55).  Here the EMA is an fp32 pytree carried in the TrainState and
+updated INSIDE the jitted train step (one fused kernel over the flat params,
+no extra HBM round-trip), directly off the optimizer's fp32 master when one
+exists — the same trick as the reference's flattened mode, which EMAs the
+flat fp32 master (ema.py:30-37).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_ema(params_or_master) -> Any:
+    """fp32 EMA shadow initialized from current params.
+
+    Must be a true copy: for fp32 params ``astype`` aliases the input buffer
+    and the aliased leaf would be donated twice in the jitted train step.
+    """
+    return jax.tree_util.tree_map(
+        lambda p: jnp.array(p, dtype=jnp.float32, copy=True), params_or_master
+    )
+
+
+def update_ema(ema, params_or_master, decay: float):
+    """p_ema <- p_ema - (1 - decay) * (p_ema - p)  (reference ema.py:39-55)."""
+    one_minus = 1.0 - decay
+
+    return jax.tree_util.tree_map(
+        lambda e, p: e - one_minus * (e - p.astype(jnp.float32)),
+        ema,
+        params_or_master,
+    )
+
+
+def ema_to_model_dtype(ema, params_template):
+    """Cast the fp32 shadow to the model's dtypes (for eval-with-EMA swap,
+    reference utils.py:436-452)."""
+    return jax.tree_util.tree_map(
+        lambda e, p: e.astype(p.dtype), ema, params_template
+    )

@@ -42,14 +42,29 @@ DEFAULT_TP_RULES = [
 ]
 
 
-def param_spec(path: str, shape, rules=None) -> P:
-    """Partition spec for one parameter by path-rule matching."""
+def param_spec(path: str, shape, rules=None, axis_sizes=None) -> P:
+    """Partition spec for one parameter by path-rule matching.
+
+    ``axis_sizes``: mesh axis-name -> size; a rule only applies when every
+    sharded dim is divisible by its axis size (otherwise replicate)."""
     rules = DEFAULT_TP_RULES if rules is None else rules
     for pattern, spec in rules:
         if re.fullmatch(pattern, path):
-            # only apply if rank matches and dims divide later at pjit time
-            if len([s for s in spec if s is not None]) <= len(shape):
-                return spec
+            if len(spec) > len(shape):
+                return P()
+            if axis_sizes is not None:
+                for dim, entry in enumerate(spec):
+                    if entry is None:
+                        continue
+                    axes = entry if isinstance(entry, tuple) else (entry,)
+                    size = 1
+                    for axis in axes:
+                        if axis not in axis_sizes:
+                            return P()  # unknown mesh axis: replicate
+                        size *= axis_sizes[axis]
+                    if shape[dim] % size != 0:
+                        return P()  # indivisible: replicate (no fall-through)
+            return spec
     return P()
 
 
@@ -65,18 +80,19 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
-def params_pspecs(params, use_tp: bool = False, rules=None):
+def params_pspecs(params, use_tp: bool = False, rules=None, mesh: Mesh = None):
     """PartitionSpec pytree for a parameter pytree.
 
     Pure DP: everything replicated.  With ``use_tp``, apply the megatron
     rules.  The result feeds jit in/out shardings; gradient psums over the
     data axis are then emitted by XLA automatically.
     """
+    axis_sizes = dict(mesh.shape) if mesh is not None else None
 
     def spec_for(path, leaf):
         if not use_tp:
             return P()
-        return param_spec(_path_str(path), leaf.shape, rules)
+        return param_spec(_path_str(path), leaf.shape, rules, axis_sizes)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
 

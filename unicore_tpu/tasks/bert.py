@@ -1,0 +1,128 @@
+"""BERT masked-LM task
+(reference /root/reference/examples/bert/task.py — bundled as a built-in so
+the framework trains end-to-end out of the box; examples/bert shows the
+--user-dir plugin route).
+
+Pipeline parity: raw text (LMDB or this framework's native indexed shards)
+-> WordPiece tokenize -> BERT masking -> right-pad-to-multiple-of-8 ->
+nested-dict batches, epoch-shuffled via SortDataset over a seeded
+permutation.
+"""
+
+import logging
+import os
+
+import numpy as np
+
+from unicore_tpu.data import (
+    BertTokenizeDataset,
+    Dictionary,
+    EpochShuffleDataset,
+    MaskTokensDataset,
+    NestedDictionaryDataset,
+    NumSamplesDataset,
+    NumelDataset,
+    RightPadDataset,
+    SortDataset,
+    data_utils,
+)
+from unicore_tpu.data.indexed_dataset import IndexedPickleDataset
+from unicore_tpu.data.lmdb_dataset import LMDBDataset, _HAS_LMDB
+from unicore_tpu.tasks import register_task
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+
+logger = logging.getLogger(__name__)
+
+
+def open_text_dataset(split_path_base):
+    """Open {base}.lmdb (if lmdb is installed) or the native {base}.bin/.idx
+    shard, whichever exists."""
+    lmdb_path = split_path_base + ".lmdb"
+    idx_path = split_path_base + ".idx"
+    if os.path.exists(idx_path):
+        return IndexedPickleDataset(split_path_base)
+    if os.path.exists(lmdb_path):
+        if not _HAS_LMDB:
+            raise ImportError(
+                f"{lmdb_path} exists but the lmdb package is unavailable; "
+                "convert it with scripts/convert_lmdb.py or install lmdb"
+            )
+        return LMDBDataset(lmdb_path)
+    raise FileNotFoundError(f"no dataset found at {split_path_base}.(idx|lmdb)")
+
+
+@register_task("bert")
+class BertTask(UnicoreTask):
+    """Task for training masked language models (e.g., BERT)."""
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument(
+            "data",
+            help="colon separated path to data directories list, "
+                 "iterated upon during epochs in round-robin manner",
+        )
+        parser.add_argument(
+            "--mask-prob", default=0.15, type=float,
+            help="probability of replacing a token with mask",
+        )
+        parser.add_argument(
+            "--leave-unmasked-prob", default=0.1, type=float,
+            help="probability that a masked token is unmasked",
+        )
+        parser.add_argument(
+            "--random-token-prob", default=0.1, type=float,
+            help="probability of replacing a token with a random token",
+        )
+
+    def __init__(self, args, dictionary):
+        super().__init__(args)
+        self.dictionary = dictionary
+        self.seed = args.seed
+        # add mask token
+        self.mask_idx = dictionary.add_symbol("[MASK]", is_special=True)
+
+    @classmethod
+    def setup_task(cls, args, **kwargs):
+        dictionary = Dictionary.load(os.path.join(args.data, "dict.txt"))
+        logger.info(f"dictionary: {len(dictionary)} types")
+        return cls(args, dictionary)
+
+    def load_dataset(self, split, combine=False, **kwargs):
+        split_path = os.path.join(self.args.data, split)
+        dict_path = os.path.join(self.args.data, "dict.txt")
+
+        dataset = open_text_dataset(split_path)
+        dataset = BertTokenizeDataset(
+            dataset, dict_path, max_seq_len=self.args.max_seq_len
+        )
+
+        src_dataset, tgt_dataset = MaskTokensDataset.apply_mask(
+            dataset,
+            self.dictionary,
+            pad_idx=self.dictionary.pad(),
+            mask_idx=self.mask_idx,
+            seed=self.args.seed,
+            mask_prob=self.args.mask_prob,
+            leave_unmasked_prob=self.args.leave_unmasked_prob,
+            random_token_prob=self.args.random_token_prob,
+        )
+
+        with data_utils.numpy_seed(self.args.seed):
+            shuffle = np.random.permutation(len(src_dataset))
+
+        self.datasets[split] = SortDataset(
+            NestedDictionaryDataset(
+                {
+                    "net_input": {
+                        "src_tokens": RightPadDataset(
+                            src_dataset, pad_idx=self.dictionary.pad()
+                        )
+                    },
+                    "target": RightPadDataset(
+                        tgt_dataset, pad_idx=self.dictionary.pad()
+                    ),
+                },
+            ),
+            sort_order=[shuffle],
+        )

@@ -1,0 +1,416 @@
+#!/usr/bin/env python3
+"""Train a new model on one or across multiple TPU hosts
+(reference /root/reference/unicore_cli/train.py).
+
+Same loop skeleton: epoch loop -> per-epoch train() with GroupedIterator for
+gradient accumulation -> validate_and_save with all stop conditions
+(--max-epoch, --max-update, --stop-time-hours, --stop-min-lr, --patience).
+"""
+
+import logging
+import math
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logging.basicConfig(
+    format="%(asctime)s | %(levelname)s | %(name)s | %(message)s",
+    datefmt="%Y-%m-%d %H:%M:%S",
+    level=os.environ.get("LOGLEVEL", "INFO").upper(),
+    stream=sys.stdout,
+)
+logger = logging.getLogger("unicore_tpu_cli.train")
+
+
+def main(args) -> None:
+    from unicore_tpu import (
+        checkpoint_utils,
+        options,
+        tasks,
+        utils,
+    )
+    from unicore_tpu.data import iterators
+    from unicore_tpu.distributed import utils as distributed_utils
+    from unicore_tpu.logging import meters, metrics, progress_bar
+    from unicore_tpu.trainer import Trainer
+
+    utils.import_user_module(args)
+
+    assert (
+        args.batch_size is not None
+    ), "Must specify batch size either with --batch-size"
+
+    metrics.reset()
+
+    import numpy as np
+    import jax
+
+    np.random.seed(args.seed)
+
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+
+    if distributed_utils.is_master(args):
+        checkpoint_utils.verify_checkpoint_directory(args.save_dir)
+        checkpoint_utils.verify_checkpoint_directory(args.tmp_save_dir)
+
+    logger.info(args)
+
+    # Setup task, e.g., molecule pretraining
+    task = tasks.setup_task(args)
+
+    assert args.loss, "Please specify loss to train a model"
+
+    # Build model and loss
+    model = task.build_model(args)
+    loss = task.build_loss(args)
+    logger.info(f"task: {task.__class__.__name__}")
+    logger.info(f"model: {model.__class__.__name__}")
+    logger.info(f"loss: {loss.__class__.__name__}")
+
+    # Build trainer
+    trainer = Trainer(args, task, model, loss)
+    logger.info(
+        f"training on {jax.device_count()} devices across "
+        f"{jax.process_count()} hosts"
+    )
+
+    # Load the latest checkpoint if one is available and restore the
+    # corresponding train iterator
+    task.load_dataset(args.train_subset, combine=False, epoch=1)
+    extra_state, epoch_itr = load_checkpoint(args, trainer)
+
+    if args.tensorboard_logdir and distributed_utils.is_master(args):
+        os.makedirs(args.tensorboard_logdir, exist_ok=True)
+
+    max_epoch = args.max_epoch or math.inf
+    lr = trainer.get_lr()
+    train_meter = meters.StopwatchMeter()
+    train_meter.start()
+
+    ckp_copy_thread = checkpoint_utils.make_copy_pool() if args.async_checkpoint else None
+
+    profiler_started = False
+    if getattr(args, "profile", False):
+        import jax.profiler
+
+        jax.profiler.start_trace(
+            os.path.join(args.save_dir, "jax_trace"), create_perfetto_link=False
+        )
+        profiler_started = True
+
+    try:
+        while epoch_itr.next_epoch_idx <= max_epoch:
+            # train for one epoch
+            valid_losses, should_stop = train(
+                args, trainer, task, epoch_itr, ckp_copy_thread
+            )
+            if should_stop:
+                break
+
+            # only use first validation loss to update the learning rate
+            lr = trainer.lr_step(epoch_itr.epoch, valid_losses[0])
+
+            epoch_itr = trainer.get_train_iterator(
+                epoch_itr.next_epoch_idx,
+                load_dataset=task.has_sharded_data("train"),
+                disable_iterator_cache=False,
+            )
+    finally:
+        if profiler_started:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        if ckp_copy_thread is not None:
+            ckp_copy_thread.close()
+            ckp_copy_thread.join()
+
+    train_meter.stop()
+    logger.info(f"done training in {train_meter.sum:.1f} seconds")
+
+
+def load_checkpoint(args, trainer):
+    from unicore_tpu import checkpoint_utils
+
+    extra_state = checkpoint_utils.load_checkpoint(args, trainer)
+    # restore iterator position
+    if (
+        extra_state is not None
+        and "train_iterator" in extra_state
+        and not args.reset_dataloader
+    ):
+        itr_state = extra_state["train_iterator"]
+        epoch_itr = trainer.get_train_iterator(
+            epoch=itr_state["epoch"], load_dataset=False
+        )
+        epoch_itr.load_state_dict(itr_state)
+    else:
+        epoch_itr = trainer.get_train_iterator(epoch=1, load_dataset=False)
+    trainer.maybe_init_from_iterator(epoch_itr)
+    return extra_state, epoch_itr
+
+
+def should_stop_early(args, valid_loss: Optional[float]) -> bool:
+    # skip check if no validation was done in the current epoch
+    if valid_loss is None:
+        return False
+    if args.patience <= 0:
+        return False
+
+    def is_better(a, b):
+        return a > b if args.maximize_best_checkpoint_metric else a < b
+
+    prev_best = getattr(should_stop_early, "best", None)
+    if prev_best is None or is_better(valid_loss, prev_best):
+        should_stop_early.best = valid_loss
+        should_stop_early.num_runs = 0
+        return False
+    else:
+        should_stop_early.num_runs += 1
+        if should_stop_early.num_runs >= args.patience:
+            logger.info(
+                "early stop since valid performance hasn't improved for "
+                f"last {args.patience} runs"
+            )
+        return should_stop_early.num_runs >= args.patience
+
+
+def train(args, trainer, task, epoch_itr, ckp_copy_thread):
+    """Train the model for one epoch and return validation losses."""
+    from unicore_tpu.data import iterators
+    from unicore_tpu.distributed import utils as distributed_utils
+    from unicore_tpu.logging import metrics, progress_bar
+
+    with metrics.aggregate(name="train_outer"):
+        # Initialize data iterator
+        itr = epoch_itr.next_epoch_itr(
+            fix_batches_to_gpus=args.fix_batches_to_gpus,
+            shuffle=(epoch_itr.next_epoch_idx > args.curriculum),
+        )
+        update_freq = (
+            args.update_freq[epoch_itr.epoch - 1]
+            if epoch_itr.epoch <= len(args.update_freq)
+            else args.update_freq[-1]
+        )
+        itr = iterators.GroupedIterator(itr, update_freq)
+        progress = progress_bar.progress_bar(
+            itr,
+            log_format=args.log_format,
+            log_interval=args.log_interval,
+            epoch=epoch_itr.epoch,
+            tensorboard_logdir=(
+                args.tensorboard_logdir if distributed_utils.is_master(args) else None
+            ),
+            default_log_format=("tqdm" if not args.no_progress_bar else "simple"),
+            wandb_project=(
+                args.wandb_project if distributed_utils.is_master(args) else None
+            ),
+            wandb_name=args.wandb_name,
+        )
+
+        trainer.begin_epoch(epoch_itr.epoch)
+
+        valid_subsets = args.valid_subset.split(",")
+        should_stop = False
+        num_updates = trainer.get_num_updates()
+        for i, samples in enumerate(progress):
+            with metrics.aggregate("train_inner"):
+                log_output = trainer.train_step(samples)
+
+            if log_output is not None:  # not OOM, overflow, ...
+                # log mid-epoch stats
+                num_updates = trainer.get_num_updates()
+                if num_updates % args.log_interval == 0:
+                    stats = get_training_stats(
+                        metrics.get_smoothed_values("train_inner")
+                    )
+                    progress.log(stats, tag="train_inner", step=num_updates)
+
+                    # reset mid-epoch stats after each log interval
+                    # the end-of-epoch stats will still be preserved
+                    metrics.reset_meters("train_inner")
+
+            end_of_epoch = not itr.has_next()
+            valid_losses, should_stop = validate_and_save(
+                args,
+                trainer,
+                task,
+                epoch_itr,
+                valid_subsets,
+                end_of_epoch,
+                ckp_copy_thread,
+            )
+
+            if should_stop:
+                break
+
+    # log end-of-epoch stats
+    logger.info(f"end of epoch {epoch_itr.epoch} (average epoch stats below)")
+    stats = get_training_stats(metrics.get_smoothed_values("train"))
+    progress.print(stats, tag="train", step=num_updates)
+
+    # reset epoch-level meters
+    metrics.reset_meters("train")
+    return valid_losses, should_stop
+
+
+def validate_and_save(
+    args, trainer, task, epoch_itr, valid_subsets, end_of_epoch, ckp_copy_thread
+) -> Tuple[List[Optional[float]], bool]:
+    from unicore_tpu import checkpoint_utils
+
+    num_updates = trainer.get_num_updates()
+    max_update = args.max_update or math.inf
+
+    # Stopping conditions (and an additional one based on validation loss later
+    # on)
+    should_stop = False
+    if num_updates >= max_update:
+        should_stop = True
+        logger.info(
+            f"Stopping training due to "
+            f"num_updates: {num_updates} >= max_update: {max_update}"
+        )
+
+    training_time_hours = trainer.cumulative_training_time() / (60 * 60)
+    if args.stop_time_hours > 0 and training_time_hours > args.stop_time_hours:
+        should_stop = True
+        logger.info(
+            f"Stopping training due to "
+            f"cumulative_training_time: {training_time_hours} > "
+            f"stop_time_hours: {args.stop_time_hours} hour(s)"
+        )
+
+    do_save = (
+        (end_of_epoch and epoch_itr.epoch % args.save_interval == 0)
+        or should_stop
+        or (
+            args.save_interval_updates > 0
+            and num_updates > 0
+            and num_updates % args.save_interval_updates == 0
+            and num_updates >= args.validate_after_updates
+        )
+    )
+    do_validate = (
+        (not end_of_epoch and do_save)  # validate during mid-epoch saves
+        or (end_of_epoch and epoch_itr.epoch % args.validate_interval == 0)
+        or should_stop
+        or (
+            args.validate_interval_updates > 0
+            and num_updates > 0
+            and num_updates % args.validate_interval_updates == 0
+        )
+    ) and not args.disable_validation
+
+    # Validate
+    valid_losses = [None]
+    if do_validate:
+        valid_losses = validate(args, trainer, task, epoch_itr, valid_subsets)
+
+    should_stop |= should_stop_early(args, valid_losses[0])
+
+    # Stopping condition on minimum lr
+    if args.stop_min_lr > -1 and trainer.get_lr() <= args.stop_min_lr:
+        should_stop = True
+        logger.info(
+            f"Stopping training due to lr: {trainer.get_lr()} <= "
+            f"stop-min-lr: {args.stop_min_lr}"
+        )
+
+    # Save checkpoint
+    if do_save or should_stop:
+        checkpoint_utils.save_checkpoint(
+            args, trainer, epoch_itr, valid_losses[0], ckp_copy_thread
+        )
+
+    return valid_losses, should_stop
+
+
+def get_training_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
+    from unicore_tpu.logging import metrics
+
+    stats["wall"] = round(metrics.get_meter("default", "wall").elapsed_time, 0)
+    return stats
+
+
+def validate(args, trainer, task, epoch_itr, subsets: List[str]) -> List[Optional[float]]:
+    """Evaluate the model on the validation set(s) and return the losses."""
+    from unicore_tpu.data import iterators
+    from unicore_tpu.distributed import utils as distributed_utils
+    from unicore_tpu.logging import metrics, progress_bar
+
+    seed = None
+    if args.fixed_validation_seed is not None:
+        # set fixed seed for every validation
+        seed = args.fixed_validation_seed
+
+    trainer.begin_valid_epoch(epoch_itr.epoch)
+    valid_losses = []
+    for subset in subsets:
+        logger.info(f'begin validation on "{subset}" subset')
+
+        # Initialize data iterator
+        if subset not in task.datasets:
+            task.load_dataset(subset, combine=False, epoch=1)
+        itr = trainer.get_valid_iterator(subset).next_epoch_itr(shuffle=False)
+        progress = progress_bar.progress_bar(
+            itr,
+            log_format=args.log_format,
+            log_interval=args.log_interval,
+            epoch=epoch_itr.epoch,
+            prefix=f"valid on '{subset}' subset",
+            tensorboard_logdir=(
+                args.tensorboard_logdir if distributed_utils.is_master(args) else None
+            ),
+            default_log_format=("tqdm" if not args.no_progress_bar else "simple"),
+        )
+
+        # create a new root metrics aggregator so validation metrics
+        # don't pollute other aggregators (e.g., train meters)
+        with metrics.aggregate(new_root=True) as agg:
+            logging_outputs = []
+            for i, sample in enumerate(progress):
+                if (
+                    args.max_valid_steps is not None
+                    and i > args.max_valid_steps
+                ):
+                    break
+                logging_outputs.append(trainer.valid_step(sample, seed=seed))
+            task.reduce_metrics(logging_outputs, trainer.loss, subset)
+
+        # log validation stats
+        stats = get_valid_stats(args, trainer, agg.get_smoothed_values())
+        progress.print(stats, tag=subset, step=trainer.get_num_updates())
+
+        valid_losses.append(stats.get(args.best_checkpoint_metric, None))
+    return valid_losses
+
+
+def get_valid_stats(args, trainer, stats: Dict[str, Any]) -> Dict[str, Any]:
+    from unicore_tpu import checkpoint_utils
+
+    stats["num_updates"] = trainer.get_num_updates()
+    if hasattr(checkpoint_utils.save_checkpoint, "best") and (
+        args.best_checkpoint_metric in stats
+    ):
+        key = f"best_{args.best_checkpoint_metric}"
+        best_function = max if args.maximize_best_checkpoint_metric else min
+        stats[key] = best_function(
+            checkpoint_utils.save_checkpoint.best,
+            stats[args.best_checkpoint_metric],
+        )
+    return stats
+
+
+def cli_main(modify_parser: Optional[Callable] = None) -> None:
+    from unicore_tpu import options
+    from unicore_tpu.distributed import utils as distributed_utils
+
+    parser = options.get_training_parser()
+    args = options.parse_args_and_arch(parser, modify_parser=modify_parser)
+    distributed_utils.call_main(args, main)
+
+
+if __name__ == "__main__":
+    cli_main()
