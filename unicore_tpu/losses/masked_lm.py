@@ -21,11 +21,21 @@ class MaskedLMLoss(UnicoreLoss):
     def __init__(self, task):
         super().__init__(task)
         self.padding_idx = task.dictionary.pad()
+        # static bound on masked positions per row: the masking dataset
+        # draws int(mask_prob * (sz - 2) + u) <= int(mask_prob * L) + 1
+        self.mask_prob = getattr(task.args, "mask_prob", 0.15) if task.args else 0.15
 
     def forward(self, model, params, sample, rngs=None, train=True):
         target = sample["target"]
         masked_tokens = target != self.padding_idx
         sample_size = jnp.sum(masked_tokens).astype(jnp.float32)
+
+        if getattr(model, "supports_masked_gather", False):
+            return self._forward_gather(
+                model, params, sample, target, masked_tokens, sample_size,
+                rngs, train,
+            )
+
         logits = model.apply(
             params,
             **sample["net_input"],
@@ -39,7 +49,40 @@ class MaskedLMLoss(UnicoreLoss):
         safe_target = jnp.where(masked_tokens, target, 0)
         nll = -jnp.take_along_axis(lprobs, safe_target[..., None], axis=-1)[..., 0]
         loss = jnp.sum(jnp.where(masked_tokens, nll, 0.0))
-        logging_output = {
+        return loss, sample_size, self._logging(loss, target, sample_size)
+
+    def _forward_gather(
+        self, model, params, sample, target, masked_tokens, sample_size,
+        rngs, train,
+    ):
+        """Project only the masked positions (fixed-size gather) — the
+        static-shape form of the reference's boolean indexing
+        (examples/bert/model.py:183-194)."""
+        bsz, seq_len = target.shape
+        n_masked = min(seq_len, int(self.mask_prob * seq_len) + 2)
+        # top_k on the 0/1 mask: returns the masked positions first (ties
+        # broken by lowest index), padded with unmasked positions
+        vals, positions = jax.lax.top_k(masked_tokens.astype(jnp.int32), n_masked)
+        valid = vals > 0
+        logits = model.apply(
+            params,
+            **sample["net_input"],
+            masked_tokens=masked_tokens,
+            masked_positions=positions,
+            train=train,
+            rngs=rngs,
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        gathered_target = jnp.take_along_axis(target, positions, axis=1)
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        safe_target = jnp.where(valid, gathered_target, 0)
+        nll = -jnp.take_along_axis(lprobs, safe_target[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(jnp.where(valid, nll, 0.0))
+        return loss, sample_size, self._logging(loss, target, sample_size)
+
+    def _logging(self, loss, target, sample_size):
+        return {
             "loss": loss,
             "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
             "sample_size": sample_size,
@@ -47,7 +90,6 @@ class MaskedLMLoss(UnicoreLoss):
                 target.shape[1] * target.shape[0], dtype=jnp.float32
             ),
         }
-        return loss, sample_size, logging_output
 
     @staticmethod
     def reduce_metrics(logging_outputs, split="train") -> None:

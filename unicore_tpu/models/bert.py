@@ -193,10 +193,13 @@ class BertModel(BaseUnicoreModel):
                 name="classification_head",
             )
 
+    supports_masked_gather = True
+
     def __call__(
         self,
         src_tokens,
         masked_tokens=None,
+        masked_positions=None,
         features_only=False,
         classification_head: bool = False,
         train: bool = False,
@@ -209,9 +212,15 @@ class BertModel(BaseUnicoreModel):
         x = self.embed_tokens(src_tokens)
         pos = self.embed_positions(jnp.arange(seq_len, dtype=jnp.int32))
         x = x + pos[None, :, :]
-        compute_dtype = x.dtype
         x = self.sentence_encoder(x, padding_mask=padding_mask, train=train)
         if not features_only:
+            if masked_positions is not None:
+                # static-shape masked-token-only head: gather the (padded)
+                # masked positions so the vocab projection runs over ~15%
+                # of the sequence instead of all of it
+                x = jnp.take_along_axis(
+                    x, masked_positions[:, :, None], axis=1
+                )
             x = self.lm_head(x, self.embed_tokens.attend)
         if classification_head:
             x = self.classification_head(x, train=train)

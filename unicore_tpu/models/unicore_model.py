@@ -21,6 +21,11 @@ class BaseUnicoreModel(nn.Module):
     ``build_model(args, task)`` constructs the module instance.
     """
 
+    # models that accept a fixed-size ``masked_positions`` gather (the
+    # static-shape version of the reference's masked-token-only LM head,
+    # examples/bert/model.py:183-194) advertise it here so losses can use it
+    supports_masked_gather = False
+
     @classmethod
     def add_args(cls, parser):
         """Add model-specific arguments to the parser."""

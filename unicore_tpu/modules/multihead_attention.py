@@ -2,10 +2,15 @@
 (reference /root/reference/unicore/modules/multihead_attention.py).
 
 TPU-native design: attention stays in (B, H, L, D) layout (one batched
-einsum -> MXU), the softmax(+bias)(+dropout) goes through
-:func:`unicore_tpu.ops.softmax_dropout` (XLA-fused), and the key-padding mask
-becomes an additive -inf mask instead of the reference's in-place
-masked_fill.
+einsum -> MXU).  Two execution paths behind the same API:
+
+- **flash path** (default when shapes allow and ``return_attn`` is False):
+  the Pallas blockwise kernel in ops/flash_attention.py — softmax + bias +
+  padding mask + dropout computed online, never materializing the (B,H,L,L)
+  matrix in HBM;
+- **fused-softmax path** (``return_attn`` consumers, odd shapes): XLA-fused
+  softmax(+bias)(+dropout) via ops/softmax_dropout.py, mirroring the
+  reference kernel's semantics.
 """
 
 from typing import Optional
@@ -14,6 +19,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from unicore_tpu.ops.flash_attention import flash_attention
 from unicore_tpu.ops.softmax_dropout import softmax_dropout
 
 
@@ -28,9 +34,9 @@ def _merge_heads(x):
 
 
 def _bias_to_bhll(bias, bsz, num_heads, tgt_len, src_len):
-    """Accept bias shaped (B,H,Q,K), (H,Q,K), (B*H,Q,K), (G,Q,K) with
-    B*H % G == 0, or broadcastable — the reference's bias generality
-    (softmax_dropout.py:71-97)."""
+    """Materialized-broadcast bias for the fused-softmax path — accepts
+    (B,H,Q,K), (H,Q,K), (B*H,Q,K), (G,Q,K) with B*H % G == 0, or (Q,K)
+    (the reference's bias generality, softmax_dropout.py:71-97)."""
     if bias is None:
         return None
     target = (bsz, num_heads, tgt_len, src_len)
@@ -50,12 +56,131 @@ def _bias_to_bhll(bias, bsz, num_heads, tgt_len, src_len):
     raise ValueError(f"unsupported attn bias shape {bias.shape}")
 
 
+def _bias_min_broadcast(bias, bsz, num_heads, tgt_len, src_len):
+    """Minimal-copy bias layout for the flash kernel: (1|B, 1|H, Q, K);
+    broadcast dims stay size-1 so the kernel reads each block once and the
+    bias gradient is reduced in-kernel.  Returns None when the layout can't
+    be expressed without materializing (falls back to the fused path)."""
+    if bias is None:
+        return None
+    if bias.ndim == 2:
+        return bias[None, None]
+    if bias.ndim == 3:
+        g = bias.shape[0]
+        if g == num_heads:
+            return bias[None]
+        if g == 1:
+            return bias[None]
+        if g == bsz * num_heads:
+            return bias.reshape(bsz, num_heads, tgt_len, src_len)
+        return None
+    if bias.ndim == 4:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        if Bb in (1, bsz) and Hb in (1, num_heads):
+            return bias
+        return None
+    return None
+
+
+def _flash_ok(tgt_len, src_len, head_dim, dtype):
+    """Shape/backend gate for the Pallas kernel: 128-aligned sequence
+    blocks on a TPU backend (or interpret mode for tests)."""
+    from unicore_tpu.ops import flash_attention as fa_mod
+
+    on_tpu = jax.default_backend() in ("tpu", "axon") or fa_mod._INTERPRET
+    return (
+        on_tpu
+        and tgt_len % 128 == 0
+        and src_len % 128 == 0
+        and head_dim % 8 == 0
+        and dtype in (jnp.float32, jnp.bfloat16)
+    )
+
+
+def _attend(
+    module,
+    q, k, v,
+    key_padding_mask,
+    attn_bias,
+    dropout_rate,
+    train,
+    return_attn,
+    use_flash,
+):
+    """Shared core: pick flash vs fused-softmax path."""
+    bsz, num_heads, tgt_len, head_dim = q.shape
+    src_len = k.shape[2]
+
+    if key_padding_mask is not None and key_padding_mask.ndim == 0:
+        key_padding_mask = None
+
+    eff_dropout = dropout_rate if train else 0.0
+
+    dropout_backend_ok = (
+        eff_dropout == 0.0 or jax.default_backend() in ("tpu", "axon")
+    )  # in-kernel dropout uses TPU-only PRNG primitives
+    if use_flash and not return_attn and dropout_backend_ok and _flash_ok(
+        tgt_len, src_len, head_dim, q.dtype
+    ):
+        bias_min = _bias_min_broadcast(
+            attn_bias, bsz, num_heads, tgt_len, src_len
+        )
+        if attn_bias is None or bias_min is not None:
+            seed = 0
+            if eff_dropout > 0.0:
+                seed = jax.random.randint(
+                    module.make_rng("dropout"), (), 0, 2 ** 31 - 1,
+                    dtype=jnp.int32,
+                )
+            o = flash_attention(
+                q, k, v,
+                bias=bias_min,
+                kv_padding_mask=key_padding_mask,
+                dropout_rate=eff_dropout,
+                dropout_seed=seed,
+                sm_scale=1.0,  # q is pre-scaled
+            )
+            return o, None, None
+
+    # fused-softmax path (materializes the attention matrix)
+    attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if key_padding_mask is not None:
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, attn_weights.dtype)
+        attn_weights = jnp.where(
+            key_padding_mask[:, None, None, :].astype(bool), neg, attn_weights
+        )
+    bias4 = _bias_to_bhll(attn_bias, bsz, num_heads, tgt_len, src_len)
+
+    dropout_rng = None
+    if eff_dropout > 0.0:
+        dropout_rng = module.make_rng("dropout")
+
+    if not return_attn:
+        attn = softmax_dropout(
+            attn_weights, eff_dropout, is_training=train, bias=bias4,
+            dropout_rng=dropout_rng,
+        )
+        probs_out = weights_out = None
+    else:
+        if bias4 is not None:
+            attn_weights = attn_weights + bias4
+        attn = softmax_dropout(
+            attn_weights, eff_dropout, is_training=train,
+            dropout_rng=dropout_rng, inplace=False,
+        )
+        probs_out, weights_out = attn, attn_weights
+
+    o = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+    return o, weights_out, probs_out
+
+
 class SelfMultiheadAttention(nn.Module):
     embed_dim: int
     num_heads: int
     dropout: float = 0.1
     bias: bool = True
     scaling_factor: float = 1.0
+    use_flash: bool = True
 
     @nn.compact
     def __call__(
@@ -72,56 +197,24 @@ class SelfMultiheadAttention(nn.Module):
         assert head_dim * self.num_heads == embed_dim
         scaling = (head_dim * self.scaling_factor) ** -0.5
 
-        dense = nn.Dense(
+        qkv = nn.Dense(
             3 * embed_dim,
             use_bias=self.bias,
             name="in_proj",
             kernel_init=nn.initializers.normal(0.02),
             dtype=query.dtype,
             param_dtype=jnp.float32,
-        )
-        qkv = dense(query)
+        )(query)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = _split_heads(q, self.num_heads) * scaling
         k = _split_heads(k, self.num_heads)
         v = _split_heads(v, self.num_heads)
-        src_len = k.shape[2]
 
-        # (B,H,Q,K) logits — one batched matmul on the MXU
-        attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+        o, attn_weights, attn_probs = _attend(
+            self, q, k, v, key_padding_mask, attn_bias,
+            self.dropout, train, return_attn, self.use_flash,
+        )
 
-        if key_padding_mask is not None and key_padding_mask.ndim != 0:
-            neg = jnp.asarray(jnp.finfo(jnp.float32).min, attn_weights.dtype)
-            attn_weights = jnp.where(
-                key_padding_mask[:, None, None, :].astype(bool), neg, attn_weights
-            )
-
-        bias4 = _bias_to_bhll(attn_bias, bsz, self.num_heads, tgt_len, src_len)
-
-        dropout_rng = None
-        if train and self.dropout > 0.0:
-            dropout_rng = self.make_rng("dropout")
-
-        if not return_attn:
-            attn = softmax_dropout(
-                attn_weights,
-                self.dropout,
-                is_training=train,
-                bias=bias4,
-                dropout_rng=dropout_rng,
-            )
-        else:
-            if bias4 is not None:
-                attn_weights = attn_weights + bias4
-            attn = softmax_dropout(
-                attn_weights,
-                self.dropout,
-                is_training=train,
-                dropout_rng=dropout_rng,
-                inplace=False,
-            )
-
-        o = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
         o = _merge_heads(o)
         o = nn.Dense(
             embed_dim,
@@ -134,7 +227,7 @@ class SelfMultiheadAttention(nn.Module):
         if not return_attn:
             return o
         else:
-            return o, attn_weights, attn
+            return o, attn_weights, attn_probs
 
 
 class CrossMultiheadAttention(nn.Module):
@@ -143,6 +236,7 @@ class CrossMultiheadAttention(nn.Module):
     dropout: float = 0.1
     bias: bool = True
     scaling_factor: float = 1.0
+    use_flash: bool = True
 
     @nn.compact
     def __call__(
@@ -170,30 +264,11 @@ class CrossMultiheadAttention(nn.Module):
         q = _split_heads(mk_dense("q_proj")(query), self.num_heads) * scaling
         k = _split_heads(mk_dense("k_proj")(key), self.num_heads)
         v = _split_heads(mk_dense("v_proj")(value), self.num_heads)
-        src_len = k.shape[2]
 
-        attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-
-        if key_padding_mask is not None and key_padding_mask.ndim != 0:
-            neg = jnp.asarray(jnp.finfo(jnp.float32).min, attn_weights.dtype)
-            attn_weights = jnp.where(
-                key_padding_mask[:, None, None, :].astype(bool), neg, attn_weights
-            )
-
-        bias4 = _bias_to_bhll(attn_bias, bsz, self.num_heads, tgt_len, src_len)
-
-        dropout_rng = None
-        if train and self.dropout > 0.0:
-            dropout_rng = self.make_rng("dropout")
-
-        attn = softmax_dropout(
-            attn_weights,
-            self.dropout,
-            is_training=train,
-            bias=bias4,
-            dropout_rng=dropout_rng,
+        o, _, _ = _attend(
+            self, q, k, v, key_padding_mask, attn_bias,
+            self.dropout, train, False, self.use_flash,
         )
-        o = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
         o = _merge_heads(o)
         o = mk_dense("out_proj")(o)
         return o

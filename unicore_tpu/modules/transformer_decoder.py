@@ -185,24 +185,12 @@ class TransformerDecoder(nn.Module):
             attn_bias = attn_mask
 
         if self.auto_regressive:
-            # additive causal mask (reference builds a -inf triu buffer)
-            causal = jnp.triu(
-                jnp.full((seq_len, seq_len), jnp.finfo(jnp.float32).min), 1
-            )
+            # additive causal mask (reference builds a -inf triu buffer);
+            # NEG_INF-style finite value keeps softmax rescans NaN-free
+            causal = jnp.triu(jnp.full((seq_len, seq_len), -1e30), 1)
             attn_bias = causal if attn_bias is None else attn_bias + causal
 
-        if attn_bias is not None and padding_mask is not None:
-            attn_bias = jnp.broadcast_to(
-                attn_bias.reshape((-1,) + attn_bias.shape[-3:])
-                if attn_bias.ndim > 3
-                else (attn_bias[None] if attn_bias.ndim == 3 else attn_bias[None, None]),
-                (bsz, self.attention_heads, seq_len, seq_len),
-            )
-            neg = jnp.finfo(jnp.float32).min
-            attn_bias = jnp.where(
-                padding_mask[:, None, None, :].astype(bool), neg, attn_bias
-            )
-            padding_mask = None
+        # key-padding mask passes through separately (see encoder note)
 
         for layer in self.layers:
             x = layer(

@@ -239,19 +239,10 @@ class TransformerEncoder(nn.Module):
         else:
             attn_bias = attn_mask
 
-        # fold the key-padding mask into the additive bias once, in fp32
-        if attn_bias is not None and padding_mask is not None:
-            attn_bias = jnp.broadcast_to(
-                attn_bias.reshape((-1,) + attn_bias.shape[-3:])
-                if attn_bias.ndim > 3
-                else attn_bias[None],
-                (bsz,) + (self.attention_heads, seq_len, seq_len),
-            )
-            neg = jnp.finfo(jnp.float32).min
-            attn_bias = jnp.where(
-                padding_mask[:, None, None, :].astype(bool), neg, attn_bias
-            )
-            padding_mask = None
+        # the key-padding mask stays separate from the bias: the attention
+        # paths apply it internally (the flash kernel as an in-kernel mask,
+        # the fused path as an additive -inf) — unlike the reference, which
+        # materializes a (B*H, L, L) merged tensor (transformer_encoder.py:147-155)
 
         for layer in self.layers:
             x = layer(x, padding_mask=padding_mask, attn_bias=attn_bias, train=train)

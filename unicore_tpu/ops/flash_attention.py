@@ -1,0 +1,715 @@
+"""Pallas TPU flash attention with pair-bias, padding mask, and in-kernel
+dropout.
+
+This is the TPU-native successor to the reference's fused
+softmax(+mask)(+bias)+dropout CUDA kernel
+(/root/reference/csrc/softmax_dropout/softmax_dropout_kernel.cu) carried one
+step further: instead of fusing around a materialized (B*H, L, L) attention
+matrix, the whole attention computation is blockwise-online (never writing
+the L x L matrix to HBM), which removes the reference's dominant HBM
+bandwidth cost and its O(L^2) activation memory.
+
+Capabilities (superset of the reference kernel's semantics):
+- additive bias broadcast over batch — shapes (1|B, H|1, Lq, Lk); bias
+  gradient is summed over the broadcast dims inside a dedicated kernel
+  (the reference does this sum in Python, modules/softmax_dropout.py:44-48)
+- key-padding mask (B, Lk), applied additively AND multiplicatively so fully
+  masked rows produce zeros, not NaN
+- attention dropout inside the kernel: the bit-mask is regenerated from a
+  counter-based PRNG seeded by (seed, b, h, q_block, k_block) in both the
+  forward and the backward passes — nothing is stored, mirroring the
+  reference's "recompute from Philox counters" design
+  (softmax_dropout_kernel.cu:60-68)
+- backward recomputes probabilities from the saved (out, logsumexp), i.e.
+  activation memory is O(L) per head
+
+Softmax statistics are fp32 regardless of input dtype; the p @ v matmul runs
+in the input dtype on the MXU with fp32 accumulation.
+"""
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # big finite: -inf minus -inf would NaN the rescale path
+
+# interpret mode runs the kernels on any backend (CPU tests); dropout uses
+# TPU-only PRNG primitives and stays TPU-gated
+_INTERPRET = os.environ.get("UNICORE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def set_interpret(enabled: bool):
+    global _INTERPRET
+    _INTERPRET = enabled
+
+
+def _pallas_call(*args, **kwargs):
+    return pl.pallas_call(*args, interpret=_INTERPRET, **kwargs)
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+def _pick_block(length, preferred):
+    """Largest 128-multiple block <= preferred that divides length."""
+    b = min(preferred, length)
+    while b > 128 and length % b != 0:
+        b -= 128
+    assert length % b == 0, (length, preferred)
+    return b
+
+
+def _seed_block(seed_ref, b, h, iq, ik):
+    """Identical PRNG stream per (b, h, q-block, k-block) in fwd and bwd.
+
+    The coordinates are mixed into one int32 (the lowering only takes a
+    single seed value); int32 overflow wraps, which is fine for mixing.
+    """
+    mix = seed_ref[0]
+    for coord in (b, h, iq, ik):
+        mix = mix * jnp.int32(1000003) + coord.astype(jnp.int32)
+    pltpu.prng_seed(mix)
+
+
+def _keep_mask(shape, dropout_rate):
+    """Counter-based keep mask; threshold compare on raw uint32 bits."""
+    bits = pltpu.prng_random_bits(shape)
+    bits = pltpu.bitcast(bits, jnp.uint32)
+    threshold = jnp.uint32(min(int(dropout_rate * (2 ** 32)), 2 ** 32 - 1))
+    return bits >= threshold
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(
+    seed_ref,
+    q_ref, k_ref, v_ref, bias_ref, mask_ref,
+    o_ref, lse_ref,
+    m_s, l_s, acc_s,
+    *, sm_scale, dropout_rate, nk, has_bias, has_mask,
+):
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0, 0]  # (BQ, D)
+    k = k_ref[0, 0]  # (BK, D)
+    v = v_ref[0, 0]  # (BK, D)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    if has_bias:
+        s = s + bias_ref[0, 0].astype(jnp.float32)
+    if has_mask:
+        kv_mask = mask_ref[0] != 0  # (1, BK) True = masked out
+        s = jnp.where(kv_mask, NEG_INF, s)
+
+    m_prev = m_s[:, :1]  # (BQ, 1)
+    l_prev = l_s[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_next)
+    if has_mask:
+        p = jnp.where(kv_mask, 0.0, p)  # exact zero for fully-masked rows
+    corr = jnp.exp(m_prev - m_next)
+    l_next = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+    if dropout_rate > 0.0:
+        _seed_block(seed_ref, b, h, iq, ik)
+        keep = _keep_mask(p.shape, dropout_rate)
+        p_use = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+    else:
+        p_use = p
+
+    pv = jax.lax.dot_general(
+        p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_s[...] = acc_s[...] * corr + pv
+    m_s[...] = jnp.broadcast_to(m_next, m_s.shape)
+    l_s[...] = jnp.broadcast_to(l_next, l_s.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_s[:, :1]
+        inv_l = jnp.where(l > 0.0, 1.0 / l, 0.0)
+        o_ref[0, 0] = (acc_s[...] * inv_l).astype(o_ref.dtype)
+        lse = m_s[:, :1] + jnp.log(jnp.maximum(l_s[:, :1], 1e-37))
+        lse_ref[0, 0] = lse.astype(jnp.float32)  # (BQ, 1)
+
+
+def _bias_index(Bb, Hb):
+    def idx(b, h, iq, ik, *_):
+        return (b if Bb > 1 else 0, h if Hb > 1 else 0, iq, ik)
+
+    return idx
+
+
+def _fwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q, block_k):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    BQ, BK = _pick_block(Lq, block_q), _pick_block(Lk, block_k)
+    nq, nk = _cdiv(Lq, BQ), _cdiv(Lk, BK)
+
+    has_bias = bias is not None
+    has_mask = kv_mask is not None
+
+    in_specs = [
+        pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, BK, D), lambda b, h, iq, ik, *_: (b, h, ik, 0)),
+        pl.BlockSpec((1, 1, BK, D), lambda b, h, iq, ik, *_: (b, h, ik, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_bias:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        in_specs.append(
+            pl.BlockSpec((1, 1, BQ, BK), _bias_index(Bb, Hb))
+        )
+        inputs.append(bias)
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, 1, BK), lambda b, h, iq, ik, *_: (b, 0, ik))
+        )
+        inputs.append(kv_mask)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        dropout_rate=dropout_rate,
+        nk=nk,
+        has_bias=has_bias,
+        has_mask=has_mask,
+    )
+
+    def wrapped(seed_ref, *refs):
+        n_in = len(inputs)
+        in_refs = refs[:n_in]
+        out_refs = refs[n_in:n_in + 2]
+        scratch = refs[n_in + 2:]
+        q_ref, k_ref, v_ref = in_refs[:3]
+        i = 3
+        bias_ref = in_refs[i] if has_bias else None
+        i += int(has_bias)
+        mask_ref = in_refs[i] if has_mask else None
+        kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, mask_ref, *out_refs,
+               *scratch)
+
+    out, lse = _pallas_call(
+        wrapped,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, nq, nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+                pl.BlockSpec(
+                    (1, 1, BQ, 1), lambda b, h, iq, ik, *_: (b, h, iq, 0)
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((BQ, 128), jnp.float32),
+                pltpu.VMEM((BQ, 128), jnp.float32),
+                pltpu.VMEM((BQ, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, Lq, 1), jnp.float32),
+        ],
+    )(seed, *inputs)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dq (+ per-batch ds when bias is batch-sized)
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, bias_ref, mask_ref, lse_ref, sm_scale,
+                 has_bias, has_mask):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    if has_bias:
+        s = s + bias_ref[0, 0].astype(jnp.float32)
+    kv_mask = None
+    if has_mask:
+        kv_mask = mask_ref[0] != 0  # (1, BK)
+        s = jnp.where(kv_mask, NEG_INF, s)
+    lse_col = lse_ref[0, 0]  # (BQ, 1)
+    p = jnp.exp(s - lse_col)
+    if has_mask:
+        p = jnp.where(kv_mask, 0.0, p)
+    return p, kv_mask
+
+
+def _ds_block(seed_ref, p, kv_mask, do_ref, v_ref, di_ref, dropout_rate,
+              b, h, iq, ik):
+    """Shared ds computation: ds = p * (dropout^T(do @ v^T) - di)."""
+    do = do_ref[0, 0]
+    v = v_ref[0, 0]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if dropout_rate > 0.0:
+        _seed_block(seed_ref, b, h, iq, ik)
+        keep = _keep_mask(dp.shape, dropout_rate)
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+    di_col = di_ref[0, 0]  # (BQ, 1)
+    ds = p * (dp - di_col)
+    if kv_mask is not None:
+        ds = jnp.where(kv_mask, 0.0, ds)
+    return ds
+
+
+def _dq_kernel(
+    seed_ref,
+    q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref, do_ref,
+    dq_ref,
+    dq_s,
+    *, sm_scale, dropout_rate, nk, has_bias, has_mask,
+):
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    p, kv_mask = _recompute_p(
+        q_ref, k_ref, bias_ref, mask_ref, lse_ref, sm_scale, has_bias, has_mask
+    )
+    ds = _ds_block(
+        seed_ref, p, kv_mask, do_ref, v_ref, di_ref, dropout_rate, b, h, iq, ik
+    )
+    k = k_ref[0, 0]
+    dq_s[...] += sm_scale * jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    seed_ref,
+    q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref, do_ref,
+    dk_ref, dv_ref,
+    dk_s, dv_s,
+    *, sm_scale, dropout_rate, nq, has_bias, has_mask,
+):
+    b, h, ik, iq = (pl.program_id(i) for i in range(4))
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    p, kv_mask = _recompute_p(
+        q_ref, k_ref, bias_ref, mask_ref, lse_ref, sm_scale, has_bias, has_mask
+    )
+
+    # dv += dropout(p)^T @ do
+    do = do_ref[0, 0]
+    if dropout_rate > 0.0:
+        _seed_block(seed_ref, b, h, iq, ik)
+        keep = _keep_mask(p.shape, dropout_rate)
+        p_drop = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+    else:
+        p_drop = p
+    dv_s[...] += jax.lax.dot_general(
+        p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    ds = _ds_block(
+        seed_ref, p, kv_mask, do_ref, v_ref, di_ref, dropout_rate, b, h, iq, ik
+    )
+    q = q_ref[0, 0]
+    dk_s[...] += sm_scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _db_kernel(
+    seed_ref,
+    q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref, do_ref,
+    db_ref,
+    db_s,
+    *, sm_scale, dropout_rate, nb, has_bias, has_mask,
+):
+    # grid (H, nq, nk, B): batch innermost so the bias-grad block stays
+    # resident in VMEM while the broadcast batch dim is reduced
+    h, iq, ik, b = (pl.program_id(i) for i in range(4))
+
+    @pl.when(b == 0)
+    def _init():
+        db_s[...] = jnp.zeros_like(db_s)
+
+    p, kv_mask = _recompute_p(
+        q_ref, k_ref, bias_ref, mask_ref, lse_ref, sm_scale, has_bias, has_mask
+    )
+    ds = _ds_block(
+        seed_ref, p, kv_mask, do_ref, v_ref, di_ref, dropout_rate, b, h, iq, ik
+    )
+    db_s[...] += ds
+
+    @pl.when(b == nb - 1)
+    def _finish():
+        db_ref[0, 0] = db_s[...].astype(db_ref.dtype)
+
+
+def _bwd_inputs(q, k, v, bias, kv_mask, lse, di, do, BQ, BK, *, kv_major):
+    """Input arrays + specs shared by the bwd kernels.
+
+    ``kv_major=False``: grid (B, H, nq, nk); True: grid (B, H, nk, nq).
+    """
+    if kv_major:
+        qi, ki = (lambda b, h, ik, iq, *_: (b, h, iq, 0)), (
+            lambda b, h, ik, iq, *_: (b, h, ik, 0)
+        )
+        rowi = lambda b, h, ik, iq, *_: (b, h, iq, 0)
+        maski = lambda b, h, ik, iq, *_: (b, 0, ik)
+        bi = lambda Bb, Hb: (
+            lambda b, h, ik, iq, *_: (b if Bb > 1 else 0, h if Hb > 1 else 0, iq, ik)
+        )
+    else:
+        qi, ki = (lambda b, h, iq, ik, *_: (b, h, iq, 0)), (
+            lambda b, h, iq, ik, *_: (b, h, ik, 0)
+        )
+        rowi = lambda b, h, iq, ik, *_: (b, h, iq, 0)
+        maski = lambda b, h, iq, ik, *_: (b, 0, ik)
+        bi = lambda Bb, Hb: (
+            lambda b, h, iq, ik, *_: (b if Bb > 1 else 0, h if Hb > 1 else 0, iq, ik)
+        )
+
+    D = q.shape[-1]
+    specs = [
+        pl.BlockSpec((1, 1, BQ, D), qi),
+        pl.BlockSpec((1, 1, BK, D), ki),
+        pl.BlockSpec((1, 1, BK, D), ki),
+    ]
+    inputs = [q, k, v]
+    if bias is not None:
+        specs.append(pl.BlockSpec((1, 1, BQ, BK), bi(bias.shape[0], bias.shape[1])))
+        inputs.append(bias)
+    if kv_mask is not None:
+        specs.append(pl.BlockSpec((1, 1, BK), maski))
+        inputs.append(kv_mask)
+    specs.append(pl.BlockSpec((1, 1, BQ, 1), rowi))
+    inputs.append(lse)
+    specs.append(pl.BlockSpec((1, 1, BQ, 1), rowi))
+    inputs.append(di)
+    specs.append(pl.BlockSpec((1, 1, BQ, D), qi))
+    inputs.append(do)
+    return inputs, specs
+
+
+def _make_ref_unpacker(has_bias, has_mask, n_outs, n_scratch):
+    def unpack(refs, n_in):
+        q_ref, k_ref, v_ref = refs[:3]
+        i = 3
+        bias_ref = refs[i] if has_bias else None
+        i += int(has_bias)
+        mask_ref = refs[i] if has_mask else None
+        i += int(has_mask)
+        lse_ref, di_ref, do_ref = refs[i], refs[i + 1], refs[i + 2]
+        outs = refs[n_in:n_in + n_outs]
+        scratch = refs[n_in + n_outs:]
+        return (q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref,
+                do_ref), outs, scratch
+
+    return unpack
+
+
+def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q,
+         block_k, out, lse, do):
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    BQ, BK = _pick_block(Lq, block_q), _pick_block(Lk, block_k)
+    nq, nk = _cdiv(Lq, BQ), _cdiv(Lk, BK)
+    has_bias = bias is not None
+    has_mask = kv_mask is not None
+
+    di = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                 axis=-1, keepdims=True)
+
+    # ---- dq: grid (B, H, nq, nk) -------------------------------------
+    inputs, specs = _bwd_inputs(
+        q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
+    )
+    unpack = _make_ref_unpacker(has_bias, has_mask, 1, 1)
+
+    def dq_wrapped(seed_ref, *refs):
+        in_refs, outs, scratch = unpack(refs, len(inputs))
+        _dq_kernel(
+            seed_ref, *in_refs, *outs, *scratch,
+            sm_scale=sm_scale, dropout_rate=dropout_rate, nk=nk,
+            has_bias=has_bias, has_mask=has_mask,
+        )
+
+    dq = _pallas_call(
+        dq_wrapped,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, nq, nk),
+            in_specs=specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((BQ, D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+    )(seed, *inputs)[0]
+
+    # ---- dk, dv: grid (B, H, nk, nq) ---------------------------------
+    inputs, specs = _bwd_inputs(
+        q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=True
+    )
+    unpack2 = _make_ref_unpacker(has_bias, has_mask, 2, 2)
+
+    def dkv_wrapped(seed_ref, *refs):
+        in_refs, outs, scratch = unpack2(refs, len(inputs))
+        _dkv_kernel(
+            seed_ref, *in_refs, *outs, *scratch,
+            sm_scale=sm_scale, dropout_rate=dropout_rate, nq=nq,
+            has_bias=has_bias, has_mask=has_mask,
+        )
+
+    dk, dv = _pallas_call(
+        dkv_wrapped,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, nk, nq),
+            in_specs=specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, BK, D), lambda b, h, ik, iq, *_: (b, h, ik, 0)),
+                pl.BlockSpec((1, 1, BK, D), lambda b, h, ik, iq, *_: (b, h, ik, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((BK, D), jnp.float32),
+                pltpu.VMEM((BK, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+    )(seed, *inputs)
+
+    # ---- dbias -------------------------------------------------------
+    dbias = None
+    if has_bias:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        if Bb == 1:
+            # reduce the broadcast batch dim inside the kernel:
+            # grid (H, nq, nk, B) with batch innermost
+            inputs, _ = _bwd_inputs(
+                q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
+            )
+            db_specs = [
+                pl.BlockSpec((1, 1, BQ, D), lambda h, iq, ik, b, *_: (b, h, iq, 0)),
+                pl.BlockSpec((1, 1, BK, D), lambda h, iq, ik, b, *_: (b, h, ik, 0)),
+                pl.BlockSpec((1, 1, BK, D), lambda h, iq, ik, b, *_: (b, h, ik, 0)),
+            ]
+            db_specs.append(
+                pl.BlockSpec(
+                    (1, 1, BQ, BK),
+                    lambda h, iq, ik, b, *_: (0, h if Hb > 1 else 0, iq, ik),
+                )
+            )
+            if has_mask:
+                db_specs.append(
+                    pl.BlockSpec((1, 1, BK), lambda h, iq, ik, b, *_: (b, 0, ik))
+                )
+            db_specs.append(
+                pl.BlockSpec((1, 1, BQ, 1), lambda h, iq, ik, b, *_: (b, h, iq, 0))
+            )
+            db_specs.append(
+                pl.BlockSpec((1, 1, BQ, 1), lambda h, iq, ik, b, *_: (b, h, iq, 0))
+            )
+            db_specs.append(
+                pl.BlockSpec((1, 1, BQ, D), lambda h, iq, ik, b, *_: (b, h, iq, 0))
+            )
+
+            def db_wrapped(seed_ref, *refs):
+                in_refs, outs, scratch = unpack(refs, len(inputs))
+                _db_kernel(
+                    seed_ref, *in_refs, *outs, *scratch,
+                    sm_scale=sm_scale, dropout_rate=dropout_rate, nb=B,
+                    has_bias=has_bias, has_mask=has_mask,
+                )
+
+            assert Hb == H or Hb == 1
+            # Hb == 1: the kernel writes per-head grads; reduced below
+            dbias_full = _pallas_call(
+                db_wrapped,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(H, nq, nk, B),
+                    in_specs=db_specs,
+                    out_specs=[
+                        pl.BlockSpec(
+                            (1, 1, BQ, BK), lambda h, iq, ik, b, *_: (0, h, iq, ik)
+                        ),
+                    ],
+                    scratch_shapes=[pltpu.VMEM((BQ, BK), jnp.float32)],
+                ),
+                out_shape=[
+                    jax.ShapeDtypeStruct((1, H, Lq, Lk), jnp.float32)
+                ],
+            )(seed, *inputs)[0]
+            if Hb == 1:
+                dbias_full = jnp.sum(dbias_full, axis=1, keepdims=True)
+            dbias = dbias_full.astype(bias.dtype)
+        else:
+            # per-batch bias: ds IS the bias grad; emit it from a dq-shaped
+            # pass (same recompute, full-size output)
+            inputs, specs = _bwd_inputs(
+                q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
+            )
+
+            def ds_wrapped(seed_ref, *refs):
+                in_refs, outs, _ = unpack(refs, len(inputs))
+                (q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref,
+                 do_ref) = in_refs
+                b, h, iq, ik = (pl.program_id(i) for i in range(4))
+                p, kv_m = _recompute_p(
+                    q_ref, k_ref, bias_ref, mask_ref, lse_ref, sm_scale,
+                    has_bias, has_mask,
+                )
+                ds = _ds_block(
+                    seed_ref, p, kv_m, do_ref, v_ref, di_ref, dropout_rate,
+                    b, h, iq, ik,
+                )
+                outs[0][0, 0] = ds.astype(outs[0].dtype)
+
+            dbias = _pallas_call(
+                ds_wrapped,
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=1,
+                    grid=(B, H, nq, nk),
+                    in_specs=specs,
+                    out_specs=[
+                        pl.BlockSpec(
+                            (1, 1, BQ, BK), lambda b, h, iq, ik, *_: (b, h, iq, ik)
+                        ),
+                    ],
+                ),
+                out_shape=[
+                    jax.ShapeDtypeStruct((B, H, Lq, Lk), bias.dtype)
+                ],
+            )(seed, *inputs)[0]
+            if bias.shape[1] == 1:
+                dbias = jnp.sum(dbias, axis=1, keepdims=True)
+
+    return dq, dk, dv, dbias
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, blocks):
+    out, _ = _fwd(
+        q, k, v, bias, kv_mask, seed,
+        sm_scale, dropout_rate, blocks[0], blocks[1],
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, blocks):
+    out, lse = _fwd(
+        q, k, v, bias, kv_mask, seed,
+        sm_scale, dropout_rate, blocks[0], blocks[1],
+    )
+    return out, (q, k, v, bias, kv_mask, seed, out, lse)
+
+
+def _flash_bwd(sm_scale, dropout_rate, blocks, residuals, do):
+    q, k, v, bias, kv_mask, seed, out, lse = residuals
+    dq, dk, dv, dbias = _bwd(
+        q, k, v, bias, kv_mask, seed,
+        sm_scale, dropout_rate, blocks[0], blocks[1], out, lse, do,
+    )
+    return dq, dk, dv, dbias, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    kv_padding_mask: Optional[jnp.ndarray] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: int = 0,
+    sm_scale: float = 1.0,
+    block_q: int = 256,
+    block_k: int = 512,
+) -> jnp.ndarray:
+    """Blockwise-online attention: softmax(q k^T * scale + bias, mask) v.
+
+    Args:
+        q, k, v: (B, H, L, D).  L must be a multiple of the block size
+            (the module layer pads/unpads; data pipelines already pad to a
+            multiple of 8 — use block 128-aligned seq lens for peak speed).
+        bias: additive bias broadcastable as (1|B, 1|H, Lq, Lk); learned
+            biases get correct gradients (broadcast dims reduced in-kernel).
+        kv_padding_mask: (B, Lk) bool/int; nonzero = masked out.
+        dropout_rate: attention dropout applied to the probabilities.
+        dropout_seed: int32 seed; fold in step/layer ids for decorrelation.
+    """
+    if bias is not None:
+        if bias.ndim == 3:
+            bias = bias[None]
+        assert bias.ndim == 4
+    if kv_padding_mask is not None:
+        kv_padding_mask = kv_padding_mask.astype(jnp.int32)[:, None, :]
+    seed = jnp.reshape(jnp.asarray(dropout_seed, dtype=jnp.int32), (1,))
+    return _flash(
+        q, k, v, bias, kv_padding_mask, seed,
+        sm_scale, float(dropout_rate), (block_q, block_k),
+    )
+
+
+def mha_reference(q, k, v, bias=None, kv_padding_mask=None, sm_scale=1.0):
+    """Pure-jnp reference for numerics tests."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        if bias.ndim == 3:
+            bias = bias[None]
+        s = s + bias.astype(jnp.float32)
+    if kv_padding_mask is not None:
+        s = jnp.where(kv_padding_mask[:, None, None, :].astype(bool), NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    if kv_padding_mask is not None:
+        p = jnp.where(kv_padding_mask[:, None, None, :].astype(bool), 0.0, p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -190,6 +190,10 @@ def get_parser(desc, default_task=None):
     parser.add_argument("--validate-with-ema", action="store_true")
     parser.add_argument("--debug-nans", action="store_true",
                         help="enable jax_debug_nans to localize the first NaN-producing op")
+    parser.add_argument("--donate-train-state", action="store_true",
+                        help="donate the train state buffers to the jitted step "
+                             "(halves peak HBM; on some backends donation forces "
+                             "synchronous dispatch, so default off)")
 
     from unicore_tpu.tasks import TASK_REGISTRY
     parser.add_argument("--task", metavar="TASK", default=default_task,

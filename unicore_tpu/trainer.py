@@ -56,6 +56,15 @@ from unicore_tpu.parallel import batch_sharding, make_mesh_from_args, replicated
 logger = logging.getLogger(__name__)
 
 
+def _narrow_dtype(x):
+    """Halve host->device batch bytes: token ids fit int32, floats fp32."""
+    if x.dtype == np.int64:
+        return x.astype(np.int32)
+    if x.dtype == np.float64:
+        return x.astype(np.float32)
+    return x
+
+
 class Trainer(object):
     """Main class for data-parallel (+TP-ready) training."""
 
@@ -91,6 +100,7 @@ class Trainer(object):
         self._state = None  # lazy: needs an example batch for param init
         self._dummy_batch = None
         self._cached_eval_params = None
+        self._macc = None  # device-side metric sums (see flush_metrics)
         self._num_updates = 0
         self._loss_fn = task.loss_fn(model, loss)
         self._jit_cache: Dict[str, Any] = {}
@@ -328,24 +338,51 @@ class Trainer(object):
     def _get_jit(self, name):
         if name in self._jit_cache:
             return self._jit_cache[name]
+
+        def make_rng(scalars, micro_i):
+            # rng derivation INSIDE jit: the host passes only small int32
+            # scalars, so no per-step fold_in dispatches cross the host link
+            key = jax.random.PRNGKey(scalars["seed"])
+            for f in (scalars["step"], micro_i, scalars["rank"]):
+                key = jax.random.fold_in(key, f)
+            return key
+
+        # donation: on some backends (the axon tunnel here) donated
+        # dispatches run synchronously, serializing host and device; default
+        # off — enable via --donate-train-state when HBM is tight
+        donate = bool(getattr(self.args, "donate_train_state", False))
+        def accumulate(macc, step_metrics):
+            # device-side running sums: the host reads them only at
+            # log_interval (one fetch), so logging costs nothing per step
+            upd = dict(step_metrics)
+            upd["_n"] = jnp.ones((), jnp.float32)
+            if macc is None:
+                return upd
+            return {k: macc.get(k, 0.0) + v for k, v in upd.items()}
+
         if name == "train_step":
 
-            @partial(jax.jit, donate_argnums=(0,))
-            def train_step(state, sample, lr, rng, weight):
+            @partial(jax.jit, donate_argnums=(0,) if donate else ())
+            def train_step(state, sample, scalars, macc):
+                rng = make_rng(scalars, 0)
                 grads, sample_size, logging_output = self._forward_backward(
-                    state["params"], sample, rng, state["loss_scale"], weight
+                    state["params"], sample, rng, state["loss_scale"],
+                    scalars["weight"],
                 )
-                return self._apply_update(
-                    state, grads, sample_size, logging_output, lr, rng
+                new_state, step_metrics = self._apply_update(
+                    state, grads, sample_size, logging_output,
+                    scalars["lr"], rng,
                 )
+                return new_state, accumulate(macc, step_metrics)
 
             fn = train_step
         elif name == "micro_step":
 
-            @partial(jax.jit, donate_argnums=(4,))
-            def micro_step(params, loss_scale, sample, rng, acc, weight):
+            @partial(jax.jit, donate_argnums=(3,) if donate else ())
+            def micro_step(params, loss_scale, sample, acc, scalars):
+                rng = make_rng(scalars, scalars["micro_i"])
                 grads, sample_size, logging_output = self._forward_backward(
-                    params, sample, rng, loss_scale, weight
+                    params, sample, rng, loss_scale, scalars["weight"]
                 )
                 if acc is None:
                     return grads, sample_size, logging_output
@@ -360,19 +397,22 @@ class Trainer(object):
             fn = micro_step
         elif name == "apply_step":
 
-            @partial(jax.jit, donate_argnums=(0, 1))
-            def apply_step(state, acc, lr, rng):
+            @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+            def apply_step(state, acc, scalars, macc):
+                rng = make_rng(scalars, 0)
                 grads, sample_size, logging_output = acc
-                return self._apply_update(
-                    state, grads, sample_size, logging_output, lr, rng
+                new_state, step_metrics = self._apply_update(
+                    state, grads, sample_size, logging_output,
+                    scalars["lr"], rng,
                 )
+                return new_state, accumulate(macc, step_metrics)
 
             fn = apply_step
         elif name == "valid_step":
 
             @jax.jit
-            def valid_step(params, sample, rng):
-                rngs = {"dropout": rng}
+            def valid_step(params, sample, scalars):
+                rngs = {"dropout": make_rng(scalars, 0)}
                 loss, sample_size, logging_output = self._loss_fn(
                     params, sample, rngs, False
                 )
@@ -385,6 +425,18 @@ class Trainer(object):
             raise KeyError(name)
         self._jit_cache[name] = fn
         return fn
+
+    def _step_scalars(self, micro_i=0, weight=1.0, seed=None):
+        """Small host->device scalar bundle for one step; everything else
+        (rng folding, lr math) happens inside the compiled step."""
+        return {
+            "lr": np.float32(self.get_lr()),
+            "seed": np.int32(self.args.seed if seed is None else seed),
+            "step": np.int32(self.get_num_updates()),
+            "micro_i": np.int32(micro_i),
+            "rank": np.int32(jax.process_index()),
+            "weight": np.float32(weight),
+        }
 
     # ------------------------------------------------------------------
     # hot loop API (reference trainer.py:570-848)
@@ -404,39 +456,64 @@ class Trainer(object):
 
         metrics.log_start_time("train_wall", priority=800, round=2)
 
-        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
         state = self._state
         n = len(samples)
 
         if n == 1:
             sample, weight = self._prepare_sample_or_dummy(samples[0])
-            rng = self._step_rng(0)
-            new_state, step_metrics = self._get_jit("train_step")(
-                state, sample, lr, rng, weight
+            new_state, self._macc = self._get_jit("train_step")(
+                state, sample, self._step_scalars(0, weight), self._macc
             )
         else:
             acc = None
             micro = self._get_jit("micro_step")
             for i, s in enumerate(samples):
                 sample, weight = self._prepare_sample_or_dummy(s)
-                rng = self._step_rng(i)
                 acc = micro(
-                    state["params"], state["loss_scale"], sample, rng, acc, weight
+                    state["params"], state["loss_scale"], sample, acc,
+                    self._step_scalars(i, weight),
                 )
-            new_state, step_metrics = self._get_jit("apply_step")(
-                state, acc, lr, self._step_rng(0)
+            new_state, self._macc = self._get_jit("apply_step")(
+                state, acc, self._step_scalars(0), self._macc
             )
 
         self._state = new_state
         self._cached_eval_params = None
         self.set_num_updates(self.get_num_updates() + 1)
-
-        # log asynchronously — these are device scalars, no sync here
-        logging_outputs = [step_metrics]
-        self._reduce_and_log_stats(logging_outputs, step_metrics["sample_size"],
-                                   step_metrics.get("gnorm"))
         metrics.log_stop_time("train_wall")
-        return logging_outputs
+        return True
+
+    def flush_metrics(self):
+        """Pull the device-side metric sums accumulated since the last flush
+        into the host meters (ONE device fetch).  Called by the CLI at
+        log_interval / validation / epoch boundaries."""
+        if self._macc is None:
+            return
+        # fetch-and-reset: the accumulator restarts from None so fp32 sums
+        # never grow past the precision horizon on long runs
+        delta = {k: float(v) for k, v in jax.device_get(self._macc).items()}
+        self._macc = None
+        n = delta.pop("_n", 0.0)
+        if n <= 0:
+            return
+        gnorm_sum = delta.pop("gnorm", None)
+        loss_scale_sum = delta.pop("loss_scale", None)
+        clip_cnt = delta.pop("clip", 0.0)
+        delta.pop("overflow", 0.0)
+        delta.pop("loss_unscaled_sum", 0.0)
+        metrics.log_speed("ups", n, priority=100, round=2)
+        if gnorm_sum is not None:
+            metrics.log_scalar("gnorm", gnorm_sum / n, n, priority=400, round=3)
+            clip_norm = getattr(self.args, "clip_norm", 0.0) or 0.0
+            if clip_norm > 0:
+                metrics.log_scalar(
+                    "clip", 100.0 * clip_cnt / n, n, priority=500, round=1
+                )
+        if self.use_loss_scale and loss_scale_sum is not None:
+            metrics.log_scalar(
+                "loss_scale", loss_scale_sum / n, n, priority=700, round=4
+            )
+        self.task.reduce_metrics([delta], self.loss)
 
     def valid_step(self, sample, seed=None):
         """Forward in eval mode (reference trainer.py:804-848).
@@ -448,15 +525,11 @@ class Trainer(object):
             self.init_state(sample)
         sample, weight = self._prepare_sample_or_dummy(sample)
         params = self._eval_params()
-        rng = (
-            jax.random.PRNGKey(seed) if seed is not None else self._step_rng(0)
-        )
         sample_size, logging_output = self._get_jit("valid_step")(
-            params, sample, rng
+            params, sample, self._step_scalars(0, weight, seed=seed)
         )
-        logging_output = {
-            k: (np.asarray(v) * np.asarray(weight)) for k, v in logging_output.items()
-        }
+        w = float(weight)
+        logging_output = {k: v * w for k, v in logging_output.items()}
         return logging_output
 
     def _eval_params(self):
@@ -489,6 +562,7 @@ class Trainer(object):
         data_size = self.mesh.shape[DATA_AXIS]
         divisible = all(leaf.shape[0] % data_size == 0 for leaf in leaves)
         sharding = self._batch_sharding if divisible else self._replicated
+        sample = utils.apply_to_sample(_narrow_dtype, sample)
         return utils.move_to_device(sample, sharding)
 
     def _prepare_sample_or_dummy(self, sample):
@@ -497,19 +571,11 @@ class Trainer(object):
         protocol)."""
         if sample is None or len(sample) == 0:
             assert self._dummy_batch is not None, "no dummy batch cached yet"
-            return self._dummy_batch, jnp.zeros((), dtype=jnp.float32)
+            return self._dummy_batch, 0.0
         prepared = self._prepare_sample(sample)
         if self._dummy_batch is None:
             self._dummy_batch = prepared
-        return prepared, jnp.ones((), dtype=jnp.float32)
-
-    def _step_rng(self, micro_i):
-        return utils.make_step_rng(
-            self.args.seed,
-            self.get_num_updates(),
-            micro_i,
-            jax.process_index(),
-        )
+        return prepared, 1.0
 
     # ------------------------------------------------------------------
     # iterators (reference trainer.py:484-568)
@@ -814,44 +880,6 @@ class Trainer(object):
     # ------------------------------------------------------------------
     # metrics (reference trainer.py:766-801, 1086-1124)
     # ------------------------------------------------------------------
-
-    def _reduce_and_log_stats(self, logging_outputs, sample_size, grad_norm=None):
-        metrics.log_speed("ups", 1.0, priority=100, round=2)
-        if grad_norm is not None:
-            metrics.log_scalar("gnorm", grad_norm, priority=400, round=3)
-            clip_norm = getattr(self.args, "clip_norm", 0.0) or 0.0
-            if clip_norm > 0:
-                metrics.log_scalar(
-                    "clip",
-                    logging_outputs[0].get("clip", 0.0) * 100.0,
-                    priority=500,
-                    round=1,
-                )
-        if self.use_loss_scale:
-            metrics.log_scalar(
-                "loss_scale", logging_outputs[0]["loss_scale"], priority=700, round=4
-            )
-
-        with metrics.aggregate() as agg:
-            if logging_outputs is not None:
-                # strip trainer-internal keys before the task sees them
-                task_outputs = [
-                    {
-                        k: v
-                        for k, v in lo.items()
-                        if k
-                        not in (
-                            "gnorm",
-                            "loss_scale",
-                            "overflow",
-                            "clip",
-                            "loss_unscaled_sum",
-                        )
-                    }
-                    for lo in logging_outputs
-                ]
-                self.task.reduce_metrics(task_outputs, self.loss)
-        return agg.get_smoothed_values()
 
     def get_throughput_meter(self):
         return metrics.get_meter("train", "ups")

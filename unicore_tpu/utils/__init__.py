@@ -155,20 +155,6 @@ def get_activation_fn(activation: str) -> Callable:
 # RNG helpers (reference utils.py:206-242 torch_seed ctx -> fold_in chains)
 # ---------------------------------------------------------------------------
 
-def make_step_rng(seed: int, *folds: int) -> jax.Array:
-    """Deterministic per-(step, micro-batch, rank, ...) RNG key.
-
-    Replaces the reference's ``torch_seed(seed, step, i, rank)`` context
-    (trainer.py:602-607): fold each coordinate into the base key so every
-    (update, micro-batch, data-shard) triple has a decorrelated dropout
-    stream that is reproducible across restarts.
-    """
-    key = jax.random.PRNGKey(seed)
-    for f in folds:
-        key = jax.random.fold_in(key, f)
-    return key
-
-
 # ---------------------------------------------------------------------------
 # Uni-Fold tensor helpers (reference utils.py:336-411)
 # ---------------------------------------------------------------------------

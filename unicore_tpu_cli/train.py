@@ -182,7 +182,7 @@ def train(args, trainer, task, epoch_itr, ckp_copy_thread):
     from unicore_tpu.distributed import utils as distributed_utils
     from unicore_tpu.logging import metrics, progress_bar
 
-    with metrics.aggregate(name="train_outer"):
+    with metrics.aggregate(name="train"):
         # Initialize data iterator
         itr = epoch_itr.next_epoch_itr(
             fix_batches_to_gpus=args.fix_batches_to_gpus,
@@ -217,10 +217,14 @@ def train(args, trainer, task, epoch_itr, ckp_copy_thread):
         for i, samples in enumerate(progress):
             with metrics.aggregate("train_inner"):
                 log_output = trainer.train_step(samples)
+                num_updates = trainer.get_num_updates()
+                if num_updates % args.log_interval == 0:
+                    # one device fetch per interval; inside the train_inner
+                    # context so the sums land in this aggregator too
+                    trainer.flush_metrics()
 
             if log_output is not None:  # not OOM, overflow, ...
                 # log mid-epoch stats
-                num_updates = trainer.get_num_updates()
                 if num_updates % args.log_interval == 0:
                     stats = get_training_stats(
                         metrics.get_smoothed_values("train_inner")
@@ -247,6 +251,7 @@ def train(args, trainer, task, epoch_itr, ckp_copy_thread):
 
     # log end-of-epoch stats
     logger.info(f"end of epoch {epoch_itr.epoch} (average epoch stats below)")
+    trainer.flush_metrics()
     stats = get_training_stats(metrics.get_smoothed_values("train"))
     progress.print(stats, tag="train", step=num_updates)
 
@@ -306,6 +311,7 @@ def validate_and_save(
     # Validate
     valid_losses = [None]
     if do_validate:
+        trainer.flush_metrics()
         valid_losses = validate(args, trainer, task, epoch_itr, valid_subsets)
 
     should_stop |= should_stop_early(args, valid_losses[0])
