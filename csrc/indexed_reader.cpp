@@ -1,0 +1,200 @@
+// Native reader for the framework's indexed shard format (<base>.bin/.idx)
+// — the C++ half of the data loader (counterpart of the role csrc/ plays in
+// the reference; here the device kernels are Pallas, so the native layer
+// owns host-side IO: zero-copy mmap reads, readahead control, and the
+// padded-batch collation memcpy loops that dominate Python collate time).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 dependency).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Reader {
+    const uint8_t* data = nullptr;
+    size_t data_size = 0;
+    const uint64_t* offsets = nullptr;  // n + 1 entries
+    uint64_t n = 0;
+    void* idx_map = nullptr;
+    size_t idx_size = 0;
+};
+
+constexpr char kMagic[8] = {'U', 'C', 'T', 'P', 'I', 'D', 'X', '1'};
+
+void* map_file(const char* path, size_t* size_out) {
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+        ::close(fd);
+        return nullptr;
+    }
+    void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (m == MAP_FAILED) return nullptr;
+    // random access pattern: avoid page-cache readahead thrash (the same
+    // reason the reference disables LMDB readahead, lmdb_dataset.py:16-49)
+    madvise(m, st.st_size, MADV_RANDOM);
+    *size_out = static_cast<size_t>(st.st_size);
+    return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ir_open(const char* bin_path, const char* idx_path) {
+    size_t idx_size = 0, bin_size = 0;
+    void* idx = map_file(idx_path, &idx_size);
+    if (!idx) return nullptr;
+    if (idx_size < 16 || memcmp(idx, kMagic, 8) != 0) {
+        munmap(idx, idx_size);
+        return nullptr;
+    }
+    void* bin = map_file(bin_path, &bin_size);
+    if (!bin) {
+        munmap(idx, idx_size);
+        return nullptr;
+    }
+    const uint8_t* p = static_cast<const uint8_t*>(idx);
+    uint64_t n = 0;
+    memcpy(&n, p + 8, 8);
+    // validate before trusting: a truncated/corrupt index must fail open,
+    // not SIGSEGV later in ir_read
+    if (idx_size < 16 + 8 * (n + 1)) {
+        munmap(idx, idx_size);
+        munmap(bin, bin_size);
+        return nullptr;
+    }
+    const uint64_t* offsets = reinterpret_cast<const uint64_t*>(p + 16);
+    for (uint64_t i = 0; i < n; ++i) {
+        if (offsets[i] > offsets[i + 1]) {
+            munmap(idx, idx_size);
+            munmap(bin, bin_size);
+            return nullptr;
+        }
+    }
+    if (offsets[n] > bin_size) {
+        munmap(idx, idx_size);
+        munmap(bin, bin_size);
+        return nullptr;
+    }
+    auto* r = new Reader();
+    r->idx_map = idx;
+    r->idx_size = idx_size;
+    r->n = n;
+    r->offsets = offsets;
+    r->data = static_cast<const uint8_t*>(bin);
+    r->data_size = bin_size;
+    return r;
+}
+
+int64_t ir_len(void* h) { return static_cast<Reader*>(h)->n; }
+
+int64_t ir_item_size(void* h, int64_t i) {
+    auto* r = static_cast<Reader*>(h);
+    if (i < 0 || static_cast<uint64_t>(i) >= r->n) return -1;
+    return static_cast<int64_t>(r->offsets[i + 1] - r->offsets[i]);
+}
+
+const uint8_t* ir_item_ptr(void* h, int64_t i) {
+    auto* r = static_cast<Reader*>(h);
+    if (i < 0 || static_cast<uint64_t>(i) >= r->n) return nullptr;
+    return r->data + r->offsets[i];
+}
+
+// copy item into caller buffer (ctypes-friendly)
+int64_t ir_read(void* h, int64_t i, uint8_t* out, int64_t cap) {
+    auto* r = static_cast<Reader*>(h);
+    if (i < 0 || static_cast<uint64_t>(i) >= r->n) return -1;
+    int64_t sz = static_cast<int64_t>(r->offsets[i + 1] - r->offsets[i]);
+    if (sz > cap) return -sz;  // caller retries with a bigger buffer
+    memcpy(out, r->data + r->offsets[i], sz);
+    return sz;
+}
+
+// hint the kernel to fault in the pages for an upcoming batch
+void ir_prefetch(void* h, const int64_t* indices, int64_t count) {
+    auto* r = static_cast<Reader*>(h);
+    long page = sysconf(_SC_PAGESIZE);
+    for (int64_t j = 0; j < count; ++j) {
+        int64_t i = indices[j];
+        if (i < 0 || static_cast<uint64_t>(i) >= r->n) continue;
+        uint64_t lo = r->offsets[i] & ~static_cast<uint64_t>(page - 1);
+        uint64_t hi = r->offsets[i + 1];
+        madvise(const_cast<uint8_t*>(r->data) + lo, hi - lo, MADV_WILLNEED);
+    }
+}
+
+void ir_close(void* h) {
+    auto* r = static_cast<Reader*>(h);
+    munmap(const_cast<uint8_t*>(r->data), r->data_size);
+    munmap(r->idx_map, r->idx_size);
+    delete r;
+}
+
+// ---------------------------------------------------------------------------
+// padded-batch collation (reference data_utils.collate_tokens /
+// collate_tokens_2d — the per-row copy loops, without the GIL)
+// ---------------------------------------------------------------------------
+
+void collate_tokens_i64(const int64_t** srcs, const int64_t* lens, int64_t n,
+                        int64_t width, int64_t pad, int left_pad,
+                        int64_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t* row = out + i * width;
+        int64_t len = lens[i];
+        if (left_pad) {
+            for (int64_t j = 0; j < width - len; ++j) row[j] = pad;
+            memcpy(row + (width - len), srcs[i], len * sizeof(int64_t));
+        } else {
+            memcpy(row, srcs[i], len * sizeof(int64_t));
+            for (int64_t j = len; j < width; ++j) row[j] = pad;
+        }
+    }
+}
+
+// square 2D pad: each src i is (dims[i] x dims[i]) float32, out is
+// (n x width x width), pad value prefilled by caller?  No: filled here.
+void collate_tokens_2d_f32(const float** srcs, const int64_t* dims, int64_t n,
+                           int64_t width, float pad, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        float* mat = out + i * width * width;
+        int64_t d = dims[i];
+        for (int64_t r = 0; r < width; ++r) {
+            float* row = mat + r * width;
+            if (r < d) {
+                memcpy(row, srcs[i] + r * d, d * sizeof(float));
+                for (int64_t c = d; c < width; ++c) row[c] = pad;
+            } else {
+                for (int64_t c = 0; c < width; ++c) row[c] = pad;
+            }
+        }
+    }
+}
+
+void collate_tokens_2d_i64(const int64_t** srcs, const int64_t* dims,
+                           int64_t n, int64_t width, int64_t pad,
+                           int64_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t* mat = out + i * width * width;
+        int64_t d = dims[i];
+        for (int64_t r = 0; r < width; ++r) {
+            int64_t* row = mat + r * width;
+            if (r < d) {
+                memcpy(row, srcs[i] + r * d, d * sizeof(int64_t));
+                for (int64_t c = d; c < width; ++c) row[c] = pad;
+            } else {
+                for (int64_t c = 0; c < width; ++c) row[c] = pad;
+            }
+        }
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Synthesize a tiny molecular-conformer dataset for the unimol task
+(records: {"atoms": [...], "coordinates": (L, 3)}), native shard format.
+
+Usage: python make_example_data.py [out_dir] [n_train] [n_valid]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from unicore_tpu.data.indexed_dataset import make_builder  # noqa: E402
+
+ATOMS = ["C", "N", "O", "S", "H", "F", "Cl", "Br", "P"]
+
+
+def make_mol(rng):
+    n = rng.randint(8, 48)
+    atoms = list(rng.choice(ATOMS, size=n, p=[0.4, 0.1, 0.12, 0.03, 0.25,
+                                              0.04, 0.03, 0.01, 0.02]))
+    # random walk in 3D with bond-ish step lengths
+    coords = np.cumsum(rng.randn(n, 3) * 0.8 + 0.4, axis=0)
+    coords -= coords.mean(axis=0)
+    return {"atoms": atoms, "coordinates": coords.astype(np.float32)}
+
+
+def main():
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(__file__), "example_data"
+    )
+    n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    n_valid = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+    os.makedirs(out_dir, exist_ok=True)
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]"] + ATOMS
+    with open(os.path.join(out_dir, "dict.txt"), "w") as f:
+        f.write("\n".join(vocab) + "\n")
+
+    rng = np.random.RandomState(7)
+    for split, n in [("train", n_train), ("valid", n_valid)]:
+        builder = make_builder(os.path.join(out_dir, split))
+        for _ in range(n):
+            builder.add_item(make_mol(rng))
+        builder.finalize()
+        print(f"wrote {n} conformers to {out_dir}/{split}.bin")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,3 +98,30 @@ def test_bf16_training(data_dir, tmp_path):
     out = run_cli(args)
     assert "num_updates: 6" in out
     assert "loss=nan" not in out.lower() and "loss nan" not in out.lower()
+
+
+def test_unimol_e2e(tmp_path):
+    d = tmp_path / "mol_data"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "unimol", "make_example_data.py"),
+            str(d), "64", "16",
+        ],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    argv = [
+        str(d),
+        "--task", "unimol", "--loss", "unimol", "--arch", "unimol_tiny",
+        "--optimizer", "adam", "--lr-scheduler", "fixed", "--lr", "1e-4",
+        "--warmup-updates", "0", "--max-update", "4", "--max-epoch", "2",
+        "--batch-size", "2", "--log-interval", "2", "--log-format", "simple",
+        "--save-dir", str(tmp_path / "ckpt"),
+        "--tmp-save-dir", str(tmp_path / "tmp"),
+        "--num-workers", "0", "--seed", "1", "--no-progress-bar",
+        "--required-batch-size-multiple", "1",
+    ]
+    out = run_cli(argv)
+    assert "num_updates: 4" in out
+    assert "masked_coord_loss" in out

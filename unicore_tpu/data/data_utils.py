@@ -36,6 +36,12 @@ def collate_tokens(
     size = size if pad_to_length is None else max(size, pad_to_length)
     if pad_to_multiple != 1 and size % pad_to_multiple != 0:
         size = int(((size - 0.1) // pad_to_multiple + 1) * pad_to_multiple)
+    if values[0].dtype == np.int64 and values[0].ndim == 1:
+        from . import native
+
+        out = native.collate_tokens_native(values, pad_idx, left_pad, size)
+        if out is not None:
+            return out
     res = np.full((len(values), size), pad_idx, dtype=values[0].dtype)
     for i, v in enumerate(values):
         if left_pad:
@@ -59,6 +65,14 @@ def collate_tokens_2d(
     size = size if pad_to_length is None else max(size, pad_to_length)
     if pad_to_multiple != 1 and size % pad_to_multiple != 0:
         size = int(((size - 0.1) // pad_to_multiple + 1) * pad_to_multiple)
+    if not left_pad and values[0].ndim == 2 and values[0].dtype in (
+        np.float32, np.int64,
+    ):
+        from . import native
+
+        out = native.collate_tokens_2d_native(values, pad_idx, size)
+        if out is not None:
+            return out
     res = np.full(
         (len(values), size, size) + values[0].shape[2:], pad_idx, dtype=values[0].dtype
     )

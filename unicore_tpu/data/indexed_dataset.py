@@ -62,11 +62,23 @@ class IndexedPickleDataset(UnicoreDataset):
             self._offsets = np.frombuffer(f.read(8 * (n + 1)), dtype=np.uint64)
         self._path = bin_path
         self._mmap = None
+        self._native = None
         self._n = int(n)
 
     def _ensure_open(self):
-        if self._mmap is None:
-            # lazy per-process open (fork-safe, like the reference's lazy LMDB env)
+        if self._mmap is None and self._native is None:
+            # lazy per-process open (fork-safe, like the reference's lazy
+            # LMDB env); prefer the C++ mmap reader when built
+            from . import native
+
+            if native.available():
+                try:
+                    self._native = native.NativeIndexedReader(
+                        self._path[: -len(".bin")]
+                    )
+                    return
+                except Exception:
+                    self._native = None
             self._mmap = np.memmap(self._path, dtype=np.uint8, mode="r")
 
     def __len__(self):
@@ -74,10 +86,23 @@ class IndexedPickleDataset(UnicoreDataset):
 
     def __getitem__(self, idx):
         self._ensure_open()
+        if self._native is not None:
+            return self._native[idx]
         lo, hi = int(self._offsets[idx]), int(self._offsets[idx + 1])
         return pickle.loads(self._mmap[lo:hi].tobytes())
+
+    @property
+    def supports_prefetch(self):
+        self._ensure_open()
+        return self._native is not None
+
+    def prefetch(self, indices):
+        self._ensure_open()
+        if self._native is not None:
+            self._native.prefetch(indices)
 
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_mmap"] = None
+        state["_native"] = None
         return state

@@ -1,0 +1,198 @@
+"""ctypes bindings for the native host-runtime library (csrc/).
+
+Loads ``csrc/libunicore_tpu_native.so`` when present (build it explicitly
+with ``make -C csrc``), otherwise every entry point reports unavailable and
+the pure-Python paths are used — preserving the reference's property that the framework runs with
+no native extensions built (reference setup.py:17 defaults CUDA ext off).
+"""
+
+import ctypes
+import logging
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+    "libunicore_tpu_native.so",
+)
+
+_lib = None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib if _lib is not False else None
+    if not os.path.exists(_LIB_PATH):
+        # never build implicitly: concurrent SPMD processes racing a compiler
+        # over a shared filesystem is worse than the Python fallback; build
+        # explicitly with `make -C csrc`
+        _lib = False
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        _lib = False
+        return None
+    lib.ir_open.restype = ctypes.c_void_p
+    lib.ir_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ir_len.restype = ctypes.c_int64
+    lib.ir_len.argtypes = [ctypes.c_void_p]
+    lib.ir_item_size.restype = ctypes.c_int64
+    lib.ir_item_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ir_read.restype = ctypes.c_int64
+    lib.ir_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ]
+    lib.ir_prefetch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.ir_close.argtypes = [ctypes.c_void_p]
+    lib.collate_tokens_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.collate_tokens_2d_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.collate_tokens_2d_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    logger.info(f"loaded native host-runtime library {_LIB_PATH}")
+    return lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class NativeIndexedReader:
+    """mmap shard reader backed by the C++ library."""
+
+    def __init__(self, base_path: str):
+        lib = get_lib()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.ir_open(
+            (base_path + ".bin").encode(), (base_path + ".idx").encode()
+        )
+        if not self._h:
+            raise IOError(f"native open failed for {base_path}")
+        self._n = lib.ir_len(self._h)
+        # loader threads read concurrently: scratch buffers are thread-local
+        self._tls = __import__("threading").local()
+
+    def __len__(self):
+        return self._n
+
+    def _buf_for(self, size):
+        buf = getattr(self._tls, "buf", None)
+        if buf is None or buf.size < size:
+            buf = np.empty(max(1 << 16, int(size * 1.5)), dtype=np.uint8)
+            self._tls.buf = buf
+        return buf
+
+    def read_bytes(self, i: int) -> bytes:
+        sz = self._lib.ir_item_size(self._h, i)
+        if sz < 0:
+            raise IndexError(i)
+        buf = self._buf_for(sz)
+        got = self._lib.ir_read(
+            self._h, i,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            buf.size,
+        )
+        assert got == sz, (got, sz)
+        return buf[:sz].tobytes()
+
+    def __getitem__(self, i: int):
+        return pickle.loads(self.read_bytes(i))
+
+    def prefetch(self, indices):
+        idx = np.asarray(indices, dtype=np.int64)
+        self._lib.ir_prefetch(
+            self._h, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx),
+        )
+
+    def close(self):
+        if self._h:
+            self._lib.ir_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _ptr_array(arrays, ctype):
+    ptrs = (ctypes.c_void_p * len(arrays))()
+    for i, a in enumerate(arrays):
+        ptrs[i] = a.ctypes.data
+    return ptrs
+
+
+def collate_tokens_native(values, pad_idx, left_pad, size):
+    """int64 1D padded collation via the native library; returns None when
+    unavailable or dtypes don't match."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arrs = [np.ascontiguousarray(v, dtype=np.int64) for v in values]
+    lens = np.asarray([len(a) for a in arrs], dtype=np.int64)
+    out = np.empty((len(arrs), size), dtype=np.int64)
+    lib.collate_tokens_i64(
+        _ptr_array(arrs, None),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(arrs), size, int(pad_idx), int(bool(left_pad)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def collate_tokens_2d_native(values, pad_idx, size):
+    """Square 2D padded collation (float32 or int64) via the native lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    first = np.asarray(values[0])
+    if first.ndim != 2 or first.shape[0] != first.shape[1]:
+        return None
+    if first.dtype == np.float32:
+        arrs = [np.ascontiguousarray(v, dtype=np.float32) for v in values]
+        dims = np.asarray([a.shape[0] for a in arrs], dtype=np.int64)
+        out = np.empty((len(arrs), size, size), dtype=np.float32)
+        lib.collate_tokens_2d_f32(
+            _ptr_array(arrs, None),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(arrs), size, float(pad_idx),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out
+    if first.dtype == np.int64:
+        arrs = [np.ascontiguousarray(v, dtype=np.int64) for v in values]
+        dims = np.asarray([a.shape[0] for a in arrs], dtype=np.int64)
+        out = np.empty((len(arrs), size, size), dtype=np.int64)
+        lib.collate_tokens_2d_i64(
+            _ptr_array(arrs, None),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(arrs), size, int(pad_idx),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out
+    return None

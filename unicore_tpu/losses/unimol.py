@@ -1,0 +1,111 @@
+"""Uni-Mol pretraining loss: masked-atom CE + masked-coordinate L2 +
+masked-distance smooth-L1 + representation-norm regularizers
+(BASELINE.json config 3)."""
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+from . import register_loss
+from .unicore_loss import UnicoreLoss
+
+
+def smooth_l1(pred, target, beta=1.0):
+    diff = jnp.abs(pred - target)
+    return jnp.where(diff < beta, 0.5 * diff * diff / beta, diff - 0.5 * beta)
+
+
+@register_loss("unimol")
+class UniMolLoss(UnicoreLoss):
+    def __init__(self, task):
+        super().__init__(task)
+        self.padding_idx = task.dictionary.pad()
+        args = task.args
+        self.masked_token_loss = getattr(args, "masked_token_loss", 1.0)
+        self.masked_coord_loss = getattr(args, "masked_coord_loss", 5.0)
+        self.masked_dist_loss = getattr(args, "masked_dist_loss", 10.0)
+        self.x_norm_loss = getattr(args, "x_norm_loss", 0.01)
+        self.delta_pair_repr_norm_loss = getattr(
+            args, "delta_pair_repr_norm_loss", 0.01
+        )
+
+    def forward(self, model, params, sample, rngs=None, train=True):
+        target = sample["target"]["tokens_target"]
+        masked = target != self.padding_idx  # (B, L)
+        sample_size = jnp.maximum(jnp.sum(masked).astype(jnp.float32), 1.0)
+
+        logits, dist_pred, coord_pred, x_norm, delta_norm = model.apply(
+            params, **sample["net_input"], train=train, rngs=rngs
+        )
+
+        logging = {}
+        loss = jnp.zeros((), jnp.float32)
+
+        if logits is not None:
+            lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            safe_t = jnp.where(masked, target, 0)
+            nll = -jnp.take_along_axis(lprobs, safe_t[..., None], axis=-1)[..., 0]
+            token_loss = jnp.sum(jnp.where(masked, nll, 0.0)) / sample_size
+            loss = loss + self.masked_token_loss * token_loss * sample_size
+            logging["masked_token_loss"] = token_loss * sample_size
+
+        if coord_pred is not None:
+            coord_t = sample["target"]["coord_target"]
+            cdiff = smooth_l1(
+                coord_pred.astype(jnp.float32), coord_t.astype(jnp.float32)
+            ).sum(-1)
+            coord_loss = jnp.sum(jnp.where(masked, cdiff, 0.0)) / sample_size
+            loss = loss + self.masked_coord_loss * coord_loss * sample_size
+            logging["masked_coord_loss"] = coord_loss * sample_size
+
+        if dist_pred is not None:
+            dist_t = sample["target"]["distance_target"]
+            # supervise rows of masked atoms against non-padded columns
+            col_ok = (sample["net_input"]["src_tokens"] != self.padding_idx)
+            pair_mask = masked[:, :, None] & col_ok[:, None, :]
+            ddiff = smooth_l1(
+                dist_pred.astype(jnp.float32), dist_t.astype(jnp.float32)
+            )
+            npairs = jnp.maximum(jnp.sum(pair_mask).astype(jnp.float32), 1.0)
+            dist_loss = jnp.sum(jnp.where(pair_mask, ddiff, 0.0)) / npairs
+            loss = loss + self.masked_dist_loss * dist_loss * sample_size
+            logging["masked_dist_loss"] = dist_loss * sample_size
+
+        if self.x_norm_loss > 0 and x_norm is not None:
+            loss = loss + self.x_norm_loss * x_norm * sample_size
+            logging["x_norm_loss"] = x_norm * sample_size
+        if self.delta_pair_repr_norm_loss > 0 and delta_norm is not None:
+            loss = loss + self.delta_pair_repr_norm_loss * delta_norm * sample_size
+            logging["delta_pair_repr_norm_loss"] = delta_norm * sample_size
+
+        logging.update(
+            {
+                "loss": loss,
+                "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+                "sample_size": sample_size,
+                "seq_len": jnp.asarray(
+                    target.shape[0] * target.shape[1], dtype=jnp.float32
+                ),
+            }
+        )
+        return loss, sample_size, logging
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        loss_sum = sum(log.get("loss", 0) for log in logging_outputs)
+        sample_size = sum(log.get("sample_size", 0) for log in logging_outputs)
+        metrics.log_scalar("loss", loss_sum / sample_size, sample_size, round=3)
+        for key in (
+            "masked_token_loss",
+            "masked_coord_loss",
+            "masked_dist_loss",
+            "x_norm_loss",
+            "delta_pair_repr_norm_loss",
+        ):
+            if any(key in log for log in logging_outputs):
+                v = sum(log.get(key, 0) for log in logging_outputs)
+                metrics.log_scalar(key, v / sample_size, sample_size, round=3)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -1,0 +1,332 @@
+"""Uni-Mol-style molecular pretraining model (BASELINE.json config 3:
+'Uni-Mol molecular pretraining (SE(3) pair-bias attention)').
+
+The reference framework hosts Uni-Mol as a --user-dir plugin built on its
+fused pair-bias softmax (SURVEY.md §2.2); this framework bundles the model
+family so molecular pretraining runs out of the box:
+
+- atom-type embeddings + a learned Gaussian basis over interatomic
+  distances, projected per-head into the (B, H, L, L) pair bias;
+- a pair-evolving Transformer backbone (TransformerEncoderWithPair);
+- heads: masked-atom logits, an SE(3)-equivariant coordinate head (pair
+  weights x normalized direction vectors), and a distance head.
+"""
+
+from argparse import Namespace
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import utils
+from unicore_tpu.models import register_model, register_model_architecture
+from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.modules import LayerNorm, bert_init
+from unicore_tpu.modules.transformer_encoder_with_pair import (
+    TransformerEncoderWithPair,
+)
+
+
+class NonLinearHead(nn.Module):
+    """Two-layer MLP head."""
+
+    out_dim: int
+    hidden: int = None
+    activation_fn: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x):
+        hidden = self.hidden or x.shape[-1]
+        x = nn.Dense(hidden, kernel_init=bert_init, name="linear1",
+                     dtype=x.dtype, param_dtype=jnp.float32)(x)
+        x = utils.get_activation_fn(self.activation_fn)(x)
+        x = nn.Dense(self.out_dim, kernel_init=bert_init, name="linear2",
+                     dtype=x.dtype, param_dtype=jnp.float32)(x)
+        return x
+
+
+class GaussianLayer(nn.Module):
+    """Distance featurization: per-edge-type affine on the distance, then K
+    Gaussian basis functions with learned means/stds."""
+
+    kernels: int = 128
+    edge_types: int = 1024
+
+    @nn.compact
+    def __call__(self, dist, edge_type):
+        # dist: (B, L, L); edge_type: (B, L, L) int
+        mul = nn.Embed(self.edge_types, 1, embedding_init=nn.initializers.ones,
+                       name="mul", param_dtype=jnp.float32)(edge_type)[..., 0]
+        bias = nn.Embed(self.edge_types, 1, embedding_init=nn.initializers.zeros,
+                        name="bias", param_dtype=jnp.float32)(edge_type)[..., 0]
+        x = mul * dist + bias  # (B, L, L)
+        means = self.param(
+            "means", nn.initializers.uniform(3.0), (self.kernels,), jnp.float32
+        )
+        stds = self.param(
+            "stds", nn.initializers.uniform(3.0), (self.kernels,), jnp.float32
+        )
+        std = jnp.abs(stds) + 1e-5
+        x = x[..., None]  # (B, L, L, K)
+        pre = -0.5 * jnp.square((x - means) / std)
+        a = 1.0 / (std * jnp.sqrt(2 * jnp.pi))
+        return (a * jnp.exp(pre)).astype(jnp.float32)
+
+
+class MaskLMHead(nn.Module):
+    """Masked-atom prediction head (tied or untied projection)."""
+
+    embed_dim: int
+    output_dim: int
+    activation_fn: str = "gelu"
+
+    @nn.compact
+    def __call__(self, features, embed_attend=None):
+        x = nn.Dense(self.embed_dim, kernel_init=bert_init, name="dense",
+                     dtype=features.dtype, param_dtype=jnp.float32)(features)
+        x = utils.get_activation_fn(self.activation_fn)(x)
+        x = LayerNorm(self.embed_dim, name="layer_norm")(x)
+        if embed_attend is not None:
+            x = embed_attend(x)
+        else:
+            x = nn.Dense(self.output_dim, use_bias=False, kernel_init=bert_init,
+                         name="proj", dtype=x.dtype, param_dtype=jnp.float32)(x)
+        bias = self.param("bias", nn.initializers.zeros, (self.output_dim,),
+                          jnp.float32)
+        return x + bias
+
+
+class DistanceHead(nn.Module):
+    """Pairwise distance regression from the pair representation."""
+
+    heads: int
+    activation_fn: str = "gelu"
+
+    @nn.compact
+    def __call__(self, pair):  # (B, L, L, H)
+        bsz, L, _, _ = pair.shape
+        x = nn.Dense(self.heads, kernel_init=bert_init, name="dense",
+                     dtype=pair.dtype, param_dtype=jnp.float32)(pair)
+        x = utils.get_activation_fn(self.activation_fn)(x)
+        x = LayerNorm(self.heads, name="layer_norm")(x)
+        x = nn.Dense(1, kernel_init=bert_init, name="out_proj",
+                     dtype=x.dtype, param_dtype=jnp.float32)(x)[..., 0]
+        return 0.5 * (x + x.transpose(0, 2, 1))  # symmetrize
+
+
+@register_model("unimol")
+class UniMolModel(BaseUnicoreModel):
+    vocab_size: int = 32
+    padding_idx: int = 0
+    encoder_layers: int = 15
+    encoder_embed_dim: int = 512
+    encoder_ffn_embed_dim: int = 2048
+    encoder_attention_heads: int = 64
+    dropout: float = 0.1
+    emb_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    max_seq_len: int = 512
+    activation_fn: str = "gelu"
+    post_ln: bool = False
+    gaussian_kernels: int = 128
+    masked_token_loss: float = 1.0
+    masked_coord_loss: float = 1.0
+    masked_dist_loss: float = 1.0
+
+    supports_masked_gather = False  # heads need full-sequence features
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument("--encoder-layers", type=int)
+        parser.add_argument("--encoder-embed-dim", type=int)
+        parser.add_argument("--encoder-ffn-embed-dim", type=int)
+        parser.add_argument("--encoder-attention-heads", type=int)
+        parser.add_argument("--emb-dropout", type=float, metavar="D")
+        parser.add_argument("--dropout", type=float, metavar="D")
+        parser.add_argument("--attention-dropout", type=float, metavar="D")
+        parser.add_argument("--activation-dropout", type=float, metavar="D")
+        parser.add_argument("--max-seq-len", type=int)
+        parser.add_argument("--activation-fn", type=str)
+        parser.add_argument("--post-ln", type=utils.str_to_bool)
+        parser.add_argument("--gaussian-kernels", type=int,
+                            help="number of Gaussian basis kernels for distances")
+        parser.add_argument("--masked-token-loss", type=float)
+        parser.add_argument("--masked-coord-loss", type=float)
+        parser.add_argument("--masked-dist-loss", type=float)
+
+    @classmethod
+    def build_model(cls, args, task):
+        unimol_base_architecture(args)
+        return cls(
+            vocab_size=len(task.dictionary),
+            padding_idx=task.dictionary.pad(),
+            encoder_layers=args.encoder_layers,
+            encoder_embed_dim=args.encoder_embed_dim,
+            encoder_ffn_embed_dim=args.encoder_ffn_embed_dim,
+            encoder_attention_heads=args.encoder_attention_heads,
+            dropout=args.dropout,
+            emb_dropout=args.emb_dropout,
+            attention_dropout=args.attention_dropout,
+            activation_dropout=args.activation_dropout,
+            max_seq_len=args.max_seq_len,
+            activation_fn=args.activation_fn,
+            post_ln=args.post_ln,
+            gaussian_kernels=args.gaussian_kernels,
+            masked_token_loss=args.masked_token_loss,
+            masked_coord_loss=args.masked_coord_loss,
+            masked_dist_loss=args.masked_dist_loss,
+        )
+
+    def setup(self):
+        K = self.gaussian_kernels
+        self.embed_tokens = nn.Embed(
+            self.vocab_size, self.encoder_embed_dim, embedding_init=bert_init,
+            name="embed_tokens", param_dtype=jnp.float32,
+        )
+        self.gbf = GaussianLayer(
+            kernels=K, edge_types=self.vocab_size ** 2, name="gbf"
+        )
+        self.gbf_proj = NonLinearHead(
+            out_dim=self.encoder_attention_heads, hidden=K,
+            activation_fn=self.activation_fn, name="gbf_proj",
+        )
+        self.encoder = TransformerEncoderWithPair(
+            encoder_layers=self.encoder_layers,
+            embed_dim=self.encoder_embed_dim,
+            ffn_embed_dim=self.encoder_ffn_embed_dim,
+            attention_heads=self.encoder_attention_heads,
+            emb_dropout=self.emb_dropout,
+            dropout=self.dropout,
+            attention_dropout=self.attention_dropout,
+            activation_dropout=self.activation_dropout,
+            max_seq_len=self.max_seq_len,
+            activation_fn=self.activation_fn,
+            post_ln=self.post_ln,
+            name="encoder",
+        )
+        if self.masked_token_loss > 0:
+            self.lm_head = MaskLMHead(
+                embed_dim=self.encoder_embed_dim, output_dim=self.vocab_size,
+                activation_fn=self.activation_fn, name="lm_head",
+            )
+        if self.masked_coord_loss > 0:
+            self.pair2coord_proj = NonLinearHead(
+                out_dim=1, hidden=self.encoder_attention_heads,
+                activation_fn=self.activation_fn, name="pair2coord_proj",
+            )
+        if self.masked_dist_loss > 0:
+            self.dist_head = DistanceHead(
+                heads=self.encoder_attention_heads,
+                activation_fn=self.activation_fn, name="dist_head",
+            )
+
+    def __call__(
+        self,
+        src_tokens,
+        src_coord,
+        src_distance,
+        src_edge_type,
+        encoder_masked_tokens=None,
+        features_only: bool = False,
+        train: bool = False,
+        **kwargs,
+    ):
+        padding_mask = (src_tokens == self.padding_idx).astype(jnp.float32)
+        bsz, L = src_tokens.shape
+        H = self.encoder_attention_heads
+
+        x = self.embed_tokens(src_tokens)
+
+        # gaussian pair bias: (B,L,L) dist -> (B,L,L,K) -> (B,H,L,L)
+        gbf_feature = self.gbf(src_distance, src_edge_type)
+        graph_attn_bias = self.gbf_proj(gbf_feature.astype(x.dtype))
+        graph_attn_bias = graph_attn_bias.transpose(0, 3, 1, 2)  # (B,H,L,L)
+
+        (
+            encoder_rep,
+            pair_rep,
+            delta_pair_rep,
+            x_norm,
+            delta_pair_rep_norm,
+        ) = self.encoder(
+            x, attn_mask=graph_attn_bias, padding_mask=padding_mask, train=train
+        )
+
+        if features_only:
+            return encoder_rep, pair_rep
+
+        logits = None
+        if self.masked_token_loss > 0:
+            logits = self.lm_head(encoder_rep, self.embed_tokens.attend)
+
+        encoder_coord = None
+        if self.masked_coord_loss > 0:
+            # SE(3)-equivariant coordinate update: per-pair scalar weights
+            # from the evolved pair channel, applied to direction vectors
+            coord_emb = delta_pair_rep.transpose(0, 2, 3, 1)  # (B,L,L,H)
+            attn_probs = self.pair2coord_proj(coord_emb)[..., 0]  # (B,L,L)
+            delta_pos = src_coord[:, :, None, :] - src_coord[:, None, :, :]
+            # normalize contributions by neighbor count
+            num = jnp.maximum(
+                jnp.sum(1 - padding_mask, axis=1, keepdims=True) - 1, 1
+            )[..., None]
+            coord_update = (
+                jnp.sum(attn_probs[..., None] * delta_pos, axis=2) / num
+            )
+            encoder_coord = src_coord + coord_update
+
+        encoder_distance = None
+        if self.masked_dist_loss > 0:
+            encoder_distance = self.dist_head(
+                pair_rep.transpose(0, 2, 3, 1)
+            )
+
+        return (
+            logits,
+            encoder_distance,
+            encoder_coord,
+            x_norm,
+            delta_pair_rep_norm,
+        )
+
+    def init_params(self, rng, sample):
+        ni = sample["net_input"]
+        return self.init(
+            {"params": rng, "dropout": rng},
+            jnp.asarray(ni["src_tokens"]),
+            jnp.asarray(ni["src_coord"]),
+            jnp.asarray(ni["src_distance"]),
+            jnp.asarray(ni["src_edge_type"]),
+            train=False,
+        )
+
+
+@register_model_architecture("unimol", "unimol")
+def unimol_base_architecture(args):
+    args.encoder_layers = getattr(args, "encoder_layers", 15)
+    args.encoder_embed_dim = getattr(args, "encoder_embed_dim", 512)
+    args.encoder_ffn_embed_dim = getattr(args, "encoder_ffn_embed_dim", 2048)
+    args.encoder_attention_heads = getattr(args, "encoder_attention_heads", 64)
+    args.dropout = getattr(args, "dropout", 0.1)
+    args.emb_dropout = getattr(args, "emb_dropout", 0.1)
+    args.attention_dropout = getattr(args, "attention_dropout", 0.1)
+    args.activation_dropout = getattr(args, "activation_dropout", 0.0)
+    args.max_seq_len = getattr(args, "max_seq_len", 512)
+    args.activation_fn = getattr(args, "activation_fn", "gelu")
+    args.post_ln = getattr(args, "post_ln", False)
+    args.gaussian_kernels = getattr(args, "gaussian_kernels", 128)
+    args.masked_token_loss = getattr(args, "masked_token_loss", 1.0)
+    args.masked_coord_loss = getattr(args, "masked_coord_loss", 5.0)
+    args.masked_dist_loss = getattr(args, "masked_dist_loss", 10.0)
+
+
+@register_model_architecture("unimol", "unimol_tiny")
+def unimol_tiny_architecture(args):
+    args.encoder_layers = getattr(args, "encoder_layers", 2)
+    args.encoder_embed_dim = getattr(args, "encoder_embed_dim", 64)
+    args.encoder_ffn_embed_dim = getattr(args, "encoder_ffn_embed_dim", 128)
+    args.encoder_attention_heads = getattr(args, "encoder_attention_heads", 8)
+    args.max_seq_len = getattr(args, "max_seq_len", 64)
+    args.gaussian_kernels = getattr(args, "gaussian_kernels", 32)
+    unimol_base_architecture(args)

@@ -12,9 +12,31 @@ from .transformer_encoder import (
     relative_position_bucket,
 )
 from .transformer_decoder import TransformerDecoder, TransformerDecoderLayer
+from .transformer_encoder_with_pair import TransformerEncoderWithPair
+from .evoformer import (
+    EvoformerIteration,
+    EvoformerStack,
+    GatedAttention,
+    MSAColumnAttention,
+    MSARowAttentionWithPairBias,
+    OuterProductMean,
+    Transition,
+    TriangleAttention,
+    TriangleMultiplication,
+)
 
 __all__ = [
     "CrossMultiheadAttention",
+    "EvoformerIteration",
+    "EvoformerStack",
+    "GatedAttention",
+    "MSAColumnAttention",
+    "MSARowAttentionWithPairBias",
+    "OuterProductMean",
+    "Transition",
+    "TransformerEncoderWithPair",
+    "TriangleAttention",
+    "TriangleMultiplication",
     "LayerNorm",
     "RMSNorm",
     "SelfMultiheadAttention",

@@ -1,0 +1,132 @@
+"""Transformer encoder that co-evolves a pairwise representation — the
+Uni-Mol backbone pattern (SURVEY.md §2.2: the reference's fused softmax kernel
+exists precisely to serve this pair-bias broadcast; BASELINE.json config 3).
+
+Each layer's attention consumes the running (B, H, L, L) pair bias and emits
+its pre-softmax attention weights, which become the next layer's bias — so
+the pair channel is refined alongside the atom channel.  Because the
+attention weights themselves are a model output here, this stack uses the
+fused-softmax path (``return_attn=True``), exactly like the reference's CUDA
+kernel's ``return_attn`` mode.
+"""
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import utils
+from .layer_norm import LayerNorm
+from .transformer_encoder import TransformerEncoderLayer, bert_init
+
+
+class TransformerEncoderWithPair(nn.Module):
+    encoder_layers: int = 6
+    embed_dim: int = 512
+    ffn_embed_dim: int = 2048
+    attention_heads: int = 64
+    emb_dropout: float = 0.1
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    max_seq_len: int = 256
+    activation_fn: str = "gelu"
+    post_ln: bool = False
+    no_final_head_layer_norm: bool = False
+
+    def setup(self):
+        self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
+        self.emb_dropout_module = nn.Dropout(rate=self.emb_dropout)
+        if not self.post_ln:
+            self.final_layer_norm = LayerNorm(self.embed_dim, name="final_layer_norm")
+        if not self.no_final_head_layer_norm:
+            self.final_head_layer_norm = LayerNorm(
+                self.attention_heads, name="final_head_layer_norm"
+            )
+        self.layers = [
+            TransformerEncoderLayer(
+                embed_dim=self.embed_dim,
+                ffn_embed_dim=self.ffn_embed_dim,
+                attention_heads=self.attention_heads,
+                dropout=self.dropout,
+                attention_dropout=self.attention_dropout,
+                activation_dropout=self.activation_dropout,
+                activation_fn=self.activation_fn,
+                post_ln=self.post_ln,
+                name=f"layers_{i}",
+            )
+            for i in range(self.encoder_layers)
+        ]
+
+    def __call__(
+        self,
+        emb: jnp.ndarray,
+        attn_mask: Optional[jnp.ndarray] = None,
+        padding_mask: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Returns (x, pair_rep, delta_pair_rep, x_norm, delta_pair_rep_norm)."""
+        bsz, seq_len, _ = emb.shape
+        x = self.emb_layer_norm(emb)
+        x = self.emb_dropout_module(x, deterministic=not train)
+
+        if padding_mask is not None:
+            x = x * (1 - padding_mask[..., None].astype(x.dtype))
+
+        input_attn_mask = attn_mask
+        pair_bias = attn_mask  # (B, H, L, L) or None
+        attn_weights = None
+        for layer in self.layers:
+            x, attn_weights, _ = layer(
+                x,
+                padding_mask=padding_mask,
+                attn_bias=pair_bias,
+                return_attn=True,
+                train=train,
+            )
+            # pre-softmax weights become the evolved pair representation
+            pair_bias = attn_weights
+
+        if not self.post_ln:
+            x = self.final_layer_norm(x)
+
+        # regularization terms (Uni-Mol's x_norm / delta_pair_repr_norm):
+        # penalize drift of token activations and pair weights
+        def masked_norm(t, mask):
+            if mask is None:
+                return jnp.sqrt(jnp.mean(jnp.square(t)) + 1e-12)
+            keep = (1 - mask).astype(t.dtype)
+            return jnp.sqrt(
+                jnp.sum(jnp.square(t * keep[..., None]))
+                / (jnp.sum(keep) * t.shape[-1] + 1e-6)
+                + 1e-12
+            )
+
+        x_norm = masked_norm(x.astype(jnp.float32), padding_mask)
+
+        pair_rep = attn_weights  # (B, H, L, L)
+        if input_attn_mask is not None:
+            delta = pair_rep - jnp.broadcast_to(
+                input_attn_mask.reshape((-1,) + input_attn_mask.shape[-3:])
+                if input_attn_mask.ndim == 4
+                else input_attn_mask[None],
+                pair_rep.shape,
+            )
+        else:
+            delta = pair_rep
+        # mask out padded pairs
+        if padding_mask is not None:
+            pm = padding_mask.astype(bool)
+            pair_mask = pm[:, None, :, None] | pm[:, None, None, :]
+            delta = jnp.where(pair_mask, 0.0, delta)
+            pair_rep = jnp.where(pair_mask, 0.0, pair_rep)
+        delta_norm = jnp.sqrt(jnp.mean(jnp.square(delta.astype(jnp.float32))) + 1e-12)
+
+        if not self.no_final_head_layer_norm:
+            # (B,H,L,L) -> normalize over heads
+            d = delta.transpose(0, 2, 3, 1)
+            d = self.final_head_layer_norm(d)
+            delta = d.transpose(0, 3, 1, 2)
+
+        return x, pair_rep, delta, x_norm, delta_norm

@@ -20,3 +20,4 @@ from .sharding import (  # noqa
     params_pspecs,
     zero1_pspecs,
 )
+from .ring_attention import ring_attention, ring_self_attention  # noqa

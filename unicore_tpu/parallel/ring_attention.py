@@ -1,0 +1,127 @@
+"""Ring attention over the mesh 'seq' axis — long-context sequence/context
+parallelism (SURVEY.md §5.7: absent from the reference; first-class here).
+
+Each device holds a sequence chunk of q/k/v.  K/V chunks rotate around the
+ring via ``ppermute`` over ICI while every device accumulates its local
+queries' attention online (flash-style running max/sum), so the full L x L
+attention is computed with O(L/n) activation memory per device and
+communication fully overlapped with compute by XLA's collective scheduler.
+
+Usage: under ``shard_map`` with the sequence dim sharded over ``axis_name``:
+
+    out = ring_attention(q, k, v, axis_name='seq', kv_mask=local_mask)
+
+or through :func:`ring_self_attention`, which wraps the shard_map given a
+mesh.  Numerically equivalent to full softmax attention (see
+tests/test_ring_attention.py).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    kv_mask: Optional[jnp.ndarray] = None,
+    sm_scale: float = 1.0,
+) -> jnp.ndarray:
+    """Online-softmax attention with a ring exchange of k/v chunks.
+
+    Args (all per-device chunks, inside shard_map):
+        q, k, v: (B, H, Lc, D) — Lc = L / ring_size
+        kv_mask: (B, Lc) nonzero = masked out (this device's key chunk)
+        sm_scale: applied to q @ k^T
+    Returns: (B, H, Lc, D) attention output for the local queries.
+    """
+    n = jax.lax.psum(1, axis_name)
+    B, H, Lc, D = q.shape
+
+    m0 = jnp.full((B, H, Lc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lc, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, Lc, D), jnp.float32)
+    # mark the initial accumulators as device-varying so the scan carry type
+    # matches the (sharded-input-derived) outputs
+    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,), to="varying")
+    if kv_mask is None:
+        kv_mask = jnp.zeros((B, k.shape[2]), jnp.int32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def accumulate(k_blk, v_blk, mask_blk, m, l, acc):
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32), k_blk.astype(jnp.float32)
+        ) * sm_scale
+        masked = mask_blk[:, None, None, :] != 0
+        s = jnp.where(masked, NEG_INF, s)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(masked, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = corr * acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    def step(carry, _):
+        k_blk, v_blk, mask_blk, m, l, acc = carry
+        m, l, acc = accumulate(k_blk, v_blk, mask_blk, m, l, acc)
+        # rotate k/v/mask to the next device; XLA overlaps this with compute
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_next = jax.lax.ppermute(mask_blk, axis_name, perm)
+        return (k_next, v_next, mask_next, m, l, acc), None
+
+    # n-1 rotated steps + a final accumulate with no rotation (the result of
+    # an n-th ppermute would never be consumed — pure wasted ICI bandwidth)
+    (k_l, v_l, mask_l, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, kv_mask, m0, l0, acc0), None, length=n - 1
+    )
+    m, l, acc = accumulate(k_l, v_l, mask_l, m, l, acc)
+    inv_l = jnp.where(l > 0, 1.0 / l, 0.0)
+    return (acc * inv_l).astype(q.dtype)
+
+
+def ring_self_attention(
+    mesh,
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kv_padding_mask: Optional[jnp.ndarray] = None,
+    sm_scale: float = 1.0,
+    seq_axis: str = "seq",
+):
+    """Full-array entry point: shards the sequence dim over ``seq_axis`` and
+    runs :func:`ring_attention` under shard_map."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    qkv_spec = P(None, None, seq_axis, None)
+    mask_spec = P(None, seq_axis)
+    out_spec = qkv_spec
+
+    if kv_padding_mask is None:
+        kv_padding_mask = jnp.zeros(
+            (q.shape[0], q.shape[2]), jnp.int32
+        )
+
+    def local_fn(q_, k_, v_, mask_):
+        return ring_attention(
+            q_, k_, v_, axis_name=seq_axis, kv_mask=mask_, sm_scale=sm_scale
+        )
+
+    fn = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=out_spec,
+    )
+    return fn(q, k, v, kv_padding_mask)
