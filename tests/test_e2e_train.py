@@ -125,3 +125,13 @@ def test_unimol_e2e(tmp_path):
     out = run_cli(argv)
     assert "num_updates: 4" in out
     assert "masked_coord_loss" in out
+
+
+def test_fp16_loss_scaling_and_ema(data_dir, tmp_path):
+    args = common_args(data_dir, str(tmp_path), 6) + [
+        "--fp16", "--fp16-init-scale", "8",
+        "--ema-decay", "0.999", "--validate-with-ema",
+    ]
+    out = run_cli(args)
+    assert "num_updates: 6" in out
+    assert "loss_scale" in out  # fp16 scale logged

@@ -1,0 +1,55 @@
+"""NanDetector: localize the first non-finite intermediate
+(the hook-free analogue of reference nan_detector.py:15-109)."""
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.nan_detector import NanDetector
+
+
+class Exploder(nn.Module):
+    @nn.compact
+    def __call__(self, src_tokens, train=False):
+        x = nn.Dense(8, name="ok_layer")(src_tokens)
+        x = nn.Dense(8, name="bad_layer")(x)
+        x = x / 0.0  # inf -> nan downstream
+        x = nn.Dense(8, name="after_layer")(x)
+        return x
+
+
+def test_nan_detector_finds_first_bad_module():
+    model = Exploder()
+    x = jnp.ones((2, 4))
+    params = model.init(jax.random.PRNGKey(0), x)
+    det = NanDetector(model)
+    msg = det.check_forward(params, {"net_input": {"src_tokens": x}})
+    assert msg is not None
+    assert "after_layer" in msg  # first module whose OUTPUT is non-finite
+
+
+def test_nan_detector_clean_model_returns_none():
+    model = nn.Dense(4)
+    x = jnp.ones((2, 4))
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    class Wrap(nn.Module):
+        @nn.compact
+        def __call__(self, src_tokens, train=False):
+            return nn.Dense(4, name="d")(src_tokens)
+
+    m = Wrap()
+    p = m.init(jax.random.PRNGKey(0), x)
+    det = NanDetector(m)
+    assert det.check_forward(p, {"net_input": {"src_tokens": x}}) is None
+
+
+def test_nan_detector_check_grads():
+    det = NanDetector(None)
+    good = {"a": jnp.ones((3,))}
+    bad = {"a": jnp.asarray([1.0, jnp.nan, 2.0])}
+    assert det.check_grads(good) is None
+    msg = det.check_grads(bad)
+    assert msg is not None and "a" in msg

@@ -31,8 +31,9 @@ class LeftPadDataset(PadDataset):
 
 
 class RightPadDataset(PadDataset):
-    def __init__(self, dataset, pad_idx):
-        super().__init__(dataset, pad_idx, left_pad=False)
+    def __init__(self, dataset, pad_idx, pad_to_multiple=8):
+        super().__init__(dataset, pad_idx, left_pad=False,
+                         pad_to_multiple=pad_to_multiple)
 
 
 class RightPadDataset2D(BaseWrapperDataset):

@@ -74,6 +74,11 @@ class BertTask(UnicoreTask):
             "--random-token-prob", default=0.1, type=float,
             help="probability of replacing a token with a random token",
         )
+        parser.add_argument(
+            "--seq-pad-multiple", default=8, type=int,
+            help="pad batch sequence lengths to this multiple; 128 aligns "
+                 "batches with the flash-attention kernel's block size",
+        )
 
     def __init__(self, args, dictionary):
         super().__init__(args)
@@ -116,11 +121,15 @@ class BertTask(UnicoreTask):
                 {
                     "net_input": {
                         "src_tokens": RightPadDataset(
-                            src_dataset, pad_idx=self.dictionary.pad()
+                            src_dataset,
+                            pad_idx=self.dictionary.pad(),
+                            pad_to_multiple=self.args.seq_pad_multiple,
                         )
                     },
                     "target": RightPadDataset(
-                        tgt_dataset, pad_idx=self.dictionary.pad()
+                        tgt_dataset,
+                        pad_idx=self.dictionary.pad(),
+                        pad_to_multiple=self.args.seq_pad_multiple,
                     ),
                 },
             ),

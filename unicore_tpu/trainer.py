@@ -499,8 +499,20 @@ class Trainer(object):
         gnorm_sum = delta.pop("gnorm", None)
         loss_scale_sum = delta.pop("loss_scale", None)
         clip_cnt = delta.pop("clip", 0.0)
-        delta.pop("overflow", 0.0)
+        overflow_cnt = delta.pop("overflow", 0.0)
         delta.pop("loss_unscaled_sum", 0.0)
+        if overflow_cnt > 0 and not self.use_loss_scale:
+            # bf16/fp32 runs: non-finite grads mean those steps were
+            # skipped in-jit (the branchless version of the reference's
+            # FloatingPointError + NanDetector re-run, trainer.py:727-748);
+            # exact localization needs the offending batch, so point the
+            # user at --debug-nans (fails fast at the first bad op) and the
+            # NanDetector library API for forward-pass scans
+            logger.warning(
+                f"{int(overflow_cnt)} update(s) skipped due to non-finite "
+                "gradients in the last interval; rerun with --debug-nans "
+                "to localize the first NaN-producing op"
+            )
         metrics.log_speed("ups", n, priority=100, round=2)
         if gnorm_sum is not None:
             metrics.log_scalar("gnorm", gnorm_sum / n, n, priority=400, round=3)
