@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Convert a Uni-Core-style LMDB shard into this framework's native
+mmap-indexed format (<base>.bin/.idx).
+
+Usage: python scripts/convert_lmdb.py input.lmdb output_base
+
+Requires the `lmdb` package only for reading the input; the output needs no
+third-party reader (unicore_tpu.data.indexed_dataset / csrc native reader).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from unicore_tpu.data.indexed_dataset import make_builder  # noqa: E402
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__)
+        sys.exit(1)
+    src, dst = sys.argv[1], sys.argv[2]
+
+    import lmdb  # gated: only needed to read the source
+
+    env = lmdb.open(
+        src, subdir=False, readonly=True, lock=False, readahead=False,
+        meminit=False, max_readers=256,
+    )
+    builder = make_builder(dst)
+    n = 0
+    with env.begin() as txn:
+        for _, value in txn.cursor():
+            # LMDB values are already pickled records: copy bytes verbatim
+            builder.add_item_bytes(bytes(value))
+            n += 1
+    builder.finalize()
+    env.close()
+    print(f"converted {n} records: {src} -> {dst}.bin/.idx")
+
+
+if __name__ == "__main__":
+    main()

@@ -135,3 +135,9 @@ def test_fp16_loss_scaling_and_ema(data_dir, tmp_path):
     out = run_cli(args)
     assert "num_updates: 6" in out
     assert "loss_scale" in out  # fp16 scale logged
+
+
+def test_activation_checkpoint_training(data_dir, tmp_path):
+    args = common_args(data_dir, str(tmp_path), 4) + ["--activation-checkpoint"]
+    out = run_cli(args)
+    assert "num_updates: 4" in out

@@ -1,0 +1,80 @@
+"""Metrics/meters subsystem: aggregation contexts, priorities, round-trip
+(reference metrics.py:281-288 state_dict round-trip)."""
+
+import time
+
+from unicore_tpu.logging import meters, metrics
+
+
+def setup_function(_):
+    metrics.reset()
+
+
+def test_nested_aggregation_contexts():
+    with metrics.aggregate("outer"):
+        metrics.log_scalar("loss", 2.0)
+        with metrics.aggregate("inner"):
+            metrics.log_scalar("loss", 4.0)
+    assert metrics.get_smoothed_value("outer", "loss") == 3.0
+    assert metrics.get_smoothed_value("inner", "loss") == 4.0
+    # default aggregator sees everything
+    assert metrics.get_smoothed_value("default", "loss") == 3.0
+
+
+def test_new_root_isolation():
+    with metrics.aggregate("train"):
+        metrics.log_scalar("loss", 1.0)
+        with metrics.aggregate("valid", new_root=True):
+            metrics.log_scalar("loss", 9.0)
+        metrics.log_scalar("loss", 3.0)
+    assert metrics.get_smoothed_value("train", "loss") == 2.0
+    assert metrics.get_smoothed_value("valid", "loss") == 9.0
+
+
+def test_weighted_average():
+    with metrics.aggregate("agg"):
+        metrics.log_scalar("x", 1.0, weight=1)
+        metrics.log_scalar("x", 3.0, weight=3)
+    assert metrics.get_smoothed_value("agg", "x") == 2.5
+
+
+def test_derived_meter():
+    with metrics.aggregate("agg"):
+        metrics.log_scalar("a", 4.0)
+        metrics.log_derived("b", lambda m: m["a"].avg * 10)
+    assert metrics.get_smoothed_value("agg", "b") == 40.0
+
+
+def test_state_dict_round_trip():
+    with metrics.aggregate("train"):
+        metrics.log_scalar("loss", 5.0, weight=2, round=3)
+    state = metrics.state_dict()
+    metrics.reset()
+    metrics.load_state_dict(state)
+    assert metrics.get_smoothed_value("train", "loss") == 5.0
+    # meters keep accumulating after restore
+    with metrics.aggregate("train"):
+        metrics.log_scalar("loss", 1.0, weight=2)
+    assert metrics.get_smoothed_value("train", "loss") == 3.0
+
+
+def test_priority_ordering():
+    md = meters.MetersDict()
+    md.add_meter("late", meters.AverageMeter(), priority=50)
+    md.add_meter("early", meters.AverageMeter(), priority=10)
+    assert list(md.keys()) == ["early", "late"]
+
+
+def test_stopwatch_and_time_meters():
+    sw = meters.StopwatchMeter()
+    sw.start()
+    time.sleep(0.01)
+    sw.stop()
+    assert sw.sum > 0
+    tm = meters.TimeMeter()
+    tm.update(10)
+    assert tm.avg > 0
+    state = tm.state_dict()
+    tm2 = meters.TimeMeter()
+    tm2.load_state_dict(state)
+    assert tm2.n == 10

@@ -31,7 +31,11 @@ class IndexedPickleDatasetBuilder:
         self._offsets: List[int] = [0]
 
     def add_item(self, obj: Any):
-        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self.add_item_bytes(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def add_item_bytes(self, payload: bytes):
+        """Append an already-pickled record (zero re-serialization path for
+        format converters)."""
         self._data_f.write(payload)
         self._offsets.append(self._offsets[-1] + len(payload))
 

@@ -92,6 +92,7 @@ class BertModel(BaseUnicoreModel):
     activation_fn: str = "gelu"
     pooler_activation_fn: str = "tanh"
     post_ln: bool = True
+    remat: bool = False  # activation checkpointing (--activation-checkpoint)
     num_classes: int = -1  # >0 adds a classification head
 
     @classmethod
@@ -122,6 +123,9 @@ class BertModel(BaseUnicoreModel):
                             help="number of positional embeddings to learn")
         parser.add_argument("--post-ln", type=utils.str_to_bool,
                             help="use post layernorm or pre layernorm")
+        parser.add_argument("--activation-checkpoint", action="store_true",
+                            help="rematerialize encoder layers in the backward "
+                                 "pass (trade FLOPs for activation memory)")
 
     @classmethod
     def build_model(cls, args, task):
@@ -142,6 +146,7 @@ class BertModel(BaseUnicoreModel):
             activation_fn=args.activation_fn,
             pooler_activation_fn=args.pooler_activation_fn,
             post_ln=args.post_ln,
+            remat=getattr(args, "activation_checkpoint", False),
             num_classes=getattr(args, "num_classes", -1),
         )
 
@@ -175,6 +180,7 @@ class BertModel(BaseUnicoreModel):
             rel_pos_bins=32,
             max_rel_pos=128,
             post_ln=self.post_ln,
+            remat=self.remat,
             name="sentence_encoder",
         )
         self.lm_head = BertLMHead(

@@ -176,14 +176,22 @@ class TransformerEncoder(nn.Module):
     rel_pos_bins: int = 32
     max_rel_pos: int = 128
     post_ln: bool = False
+    remat: bool = False  # activation checkpointing per layer
+                         # (reference utils.checkpoint_sequential, utils.py:306-333)
 
     def setup(self):
         self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
         self.emb_dropout_module = nn.Dropout(rate=self.emb_dropout)
         if not self.post_ln:
             self.final_layer_norm = LayerNorm(self.embed_dim, name="final_layer_norm")
+        layer_cls = TransformerEncoderLayer
+        if self.remat:
+            # static argnums (incl. self at 0): return_attn=4, train=5
+            layer_cls = nn.remat(
+                TransformerEncoderLayer, static_argnums=(4, 5)
+            )
         self.layers = [
-            TransformerEncoderLayer(
+            layer_cls(
                 embed_dim=self.embed_dim,
                 ffn_embed_dim=self.ffn_embed_dim,
                 attention_heads=self.attention_heads,
@@ -245,7 +253,9 @@ class TransformerEncoder(nn.Module):
         # materializes a (B*H, L, L) merged tensor (transformer_encoder.py:147-155)
 
         for layer in self.layers:
-            x = layer(x, padding_mask=padding_mask, attn_bias=attn_bias, train=train)
+            # positional: nn.remat requires static args positionally, and the
+            # same form is valid for the plain layer
+            x = layer(x, attn_bias, padding_mask, False, train)
 
         if not self.post_ln:
             x = self.final_layer_norm(x)

@@ -245,10 +245,15 @@ class Trainer(object):
         """Shared micro-batch forward+backward (pure)."""
 
         def loss_for_grad(p):
-            rngs = {"dropout": rng}
-            loss, sample_size, logging_output = self._loss_fn(
-                p, sample, rngs, True
-            )
+            # phase names mirror the reference's record_function annotations
+            # (SURVEY.md §5.1); ops without a scope below are the backward
+            # pass (value_and_grad's cotangent computation can't be wrapped
+            # separately from the forward it differentiates)
+            with jax.named_scope("forward"):
+                rngs = {"dropout": rng}
+                loss, sample_size, logging_output = self._loss_fn(
+                    p, sample, rngs, True
+                )
             scaled = loss.astype(jnp.float32) * loss_scale * weight
             return scaled, (loss, sample_size, logging_output)
 
@@ -276,11 +281,13 @@ class Trainer(object):
     def _apply_update(self, state, grads, sample_size, logging_output, lr, rng):
         """Normalize, clip, (maybe) skip, update, EMA — pure."""
         loss_scale = state["loss_scale"]
-        denom = jnp.maximum(sample_size, 1e-8) * loss_scale
-        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+        with jax.named_scope("multiply-grads"):
+            denom = jnp.maximum(sample_size, 1e-8) * loss_scale
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
 
         clip_norm = getattr(self.args, "clip_norm", 0.0) or 0.0
-        grads, gnorm = utils.clip_grad_norm(grads, clip_norm)
+        with jax.named_scope("clip-grads"):
+            grads, gnorm = utils.clip_grad_norm(grads, clip_norm)
 
         overflow = ~jnp.isfinite(gnorm)
         if self.use_loss_scale:
@@ -296,14 +303,15 @@ class Trainer(object):
             new_scale, new_since = loss_scale, state["since_overflow"]
 
         sr_rng = jax.random.fold_in(rng, 1337)  # decorrelate SR from dropout
-        new_params, new_opt = self._optimizer.update(
-            grads,
-            state["opt"],
-            state["params"],
-            lr,
-            sr_rng=sr_rng,
-            skip_update=overflow,
-        )
+        with jax.named_scope("optimizer"):
+            new_params, new_opt = self._optimizer.update(
+                grads,
+                state["opt"],
+                state["params"],
+                lr,
+                sr_rng=sr_rng,
+                skip_update=overflow,
+            )
         new_state = {
             "params": new_params,
             "opt": new_opt,
