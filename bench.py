@@ -1,11 +1,14 @@
 #!/usr/bin/env python3
-"""Headline benchmark: BERT-base MLM training throughput (samples/sec/chip).
+"""Headline benchmark: training-step throughput on the current accelerator.
 
 Runs the REAL training path — the Trainer's fused jitted step (forward,
-backward, clip, Adam, EMA) — on whatever accelerator JAX sees (the axon TPU
-chip in this environment; no platform override here).  Config mirrors the
-reference's de-facto perf config (examples/bert/train_bert_test.sh: BERT-base,
-Adam (0.9, 0.98), seq 512) in bf16, batch size chosen for one v5e chip.
+backward, clip, Adam, EMA).  Default config mirrors the reference's de-facto
+perf config (examples/bert/train_bert_test.sh: BERT-base, Adam (0.9, 0.98),
+seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
+
+    BENCH_CONFIG=bert       (default) BERT-base MLM, samples/s/chip
+    BENCH_CONFIG=unimol     Uni-Mol pair-bias pretraining step
+    BENCH_CONFIG=evoformer  Evoformer masked-MSA step
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is null — the reference publishes no numbers (BASELINE.md).
@@ -28,8 +31,9 @@ def main():
     from unicore_tpu.tasks.unicore_task import UnicoreTask
     from unicore_tpu.trainer import Trainer
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
-    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    config = os.environ.get("BENCH_CONFIG", "bert")
+    batch_size = int(os.environ.get("BENCH_BATCH", "64" if config == "bert" else "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "512" if config == "bert" else "256"))
     vocab = 30522
     warmup, iters = 3, 10
 
@@ -73,25 +77,86 @@ def main():
         dictionary = _Dict()
 
     task = _BenchTask(args)
-    model = BertModel(
-        vocab_size=vocab,
-        padding_idx=1,
-        encoder_layers=12,
-        encoder_embed_dim=768,
-        encoder_ffn_embed_dim=3072,
-        encoder_attention_heads=12,
-        max_seq_len=seq_len,
-        post_ln=True,
-    )
-    loss = LOSS_REGISTRY["masked_lm"](task)
-    trainer = Trainer(args, task, model, loss)
-
     rng = np.random.RandomState(0)
-    tokens = rng.randint(4, vocab, size=(batch_size, seq_len)).astype(np.int64)
-    target = np.where(rng.rand(batch_size, seq_len) < 0.15, tokens, 1).astype(
-        np.int64
-    )
-    sample = {"net_input": {"src_tokens": tokens}, "target": target}
+
+    if config == "bert":
+        model = BertModel(
+            vocab_size=vocab,
+            padding_idx=1,
+            encoder_layers=12,
+            encoder_embed_dim=768,
+            encoder_ffn_embed_dim=3072,
+            encoder_attention_heads=12,
+            max_seq_len=seq_len,
+            post_ln=True,
+        )
+        loss = LOSS_REGISTRY["masked_lm"](task)
+        tokens = rng.randint(4, vocab, size=(batch_size, seq_len)).astype(np.int64)
+        target = np.where(rng.rand(batch_size, seq_len) < 0.15, tokens, 1).astype(
+            np.int64
+        )
+        sample = {"net_input": {"src_tokens": tokens}, "target": target}
+        metric = f"bert_base_mlm_bf16_seq{seq_len}_samples_per_sec_per_chip"
+    elif config == "unimol":
+        from unicore_tpu.models.unimol import UniMolModel
+
+        vsz = 32
+        task._Dict.pad = lambda self: 0
+        model = UniMolModel(
+            vocab_size=vsz, padding_idx=0, encoder_layers=15,
+            encoder_embed_dim=512, encoder_ffn_embed_dim=2048,
+            encoder_attention_heads=64, max_seq_len=seq_len,
+        )
+        setattr(args, "masked_token_loss", 1.0)
+        setattr(args, "masked_coord_loss", 5.0)
+        setattr(args, "masked_dist_loss", 10.0)
+        loss = LOSS_REGISTRY["unimol"](task)
+        tokens = rng.randint(4, vsz, size=(batch_size, seq_len)).astype(np.int64)
+        coords = rng.randn(batch_size, seq_len, 3).astype(np.float32)
+        diff = coords[:, :, None] - coords[:, None, :]
+        dist = np.sqrt((diff ** 2).sum(-1)).astype(np.float32)
+        sample = {
+            "net_input": {
+                "src_tokens": tokens,
+                "src_coord": coords,
+                "src_distance": dist,
+                "src_edge_type": (
+                    tokens[:, :, None] * vsz + tokens[:, None, :]
+                ).astype(np.int64),
+            },
+            "target": {
+                "tokens_target": np.where(
+                    rng.rand(batch_size, seq_len) < 0.15, tokens, 0
+                ).astype(np.int64),
+                "coord_target": coords,
+                "distance_target": dist,
+            },
+        }
+        metric = f"unimol_pretrain_bf16_seq{seq_len}_samples_per_sec_per_chip"
+    elif config == "evoformer":
+        from unicore_tpu.models.evoformer_model import EvoformerModel
+
+        vsz = 28
+        task._Dict.pad = lambda self: 1
+        R = int(os.environ.get("BENCH_MSA_ROWS", "32"))
+        model = EvoformerModel(
+            vocab_size=vsz, padding_idx=1, num_blocks=12,
+            msa_dim=256, pair_dim=128, max_seq_len=seq_len,
+            remat=True,  # deep pair stack: rematerialize to fit HBM
+        )
+        loss = LOSS_REGISTRY["masked_msa"](task)
+        msa = rng.randint(4, vsz, size=(batch_size, R, seq_len)).astype(np.int64)
+        sample = {
+            "net_input": {"src_msa": msa},
+            "target": np.where(
+                rng.rand(batch_size, R, seq_len) < 0.15, msa, 1
+            ).astype(np.int64),
+        }
+        metric = f"evoformer_masked_msa_bf16_L{seq_len}_samples_per_sec_per_chip"
+    else:
+        raise ValueError(f"unknown BENCH_CONFIG {config}")
+
+    trainer = Trainer(args, task, model, loss)
     # measure the training step itself: stage the batch on device once (the
     # input pipeline overlaps transfers in real runs)
     trainer.init_state(sample)
@@ -121,7 +186,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "bert_base_mlm_bf16_seq512_samples_per_sec_per_chip",
+                "metric": metric,
                 "value": round(samples_per_sec_per_chip, 2),
                 "unit": "samples/s/chip",
                 "vs_baseline": None,

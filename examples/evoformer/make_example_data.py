@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Synthesize tiny MSA records for the msa_pretrain task
+({"msa": (R, L) int ids}), native shard format.
+
+Usage: python make_example_data.py [out_dir] [n_train] [n_valid]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from unicore_tpu.data.indexed_dataset import make_builder  # noqa: E402
+
+AA = list("ACDEFGHIKLMNPQRSTVWY") + ["-"]
+SPECIALS = ["[CLS]", "[PAD]", "[SEP]", "[UNK]"]
+
+
+def make_msa(rng):
+    L = rng.randint(24, 56)
+    R = rng.randint(4, 24)
+    # target sequence + mutated homologs (ids offset by the 4 specials)
+    target = rng.randint(0, 20, size=L)
+    rows = [target]
+    for _ in range(R - 1):
+        row = target.copy()
+        n_mut = rng.randint(0, L // 3)
+        pos = rng.choice(L, size=n_mut, replace=False)
+        row[pos] = rng.randint(0, 21, size=n_mut)  # may be gap
+        rows.append(row)
+    return {"msa": (np.stack(rows) + len(SPECIALS)).astype(np.int16)}
+
+
+def main():
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(__file__), "example_data"
+    )
+    n_train = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    n_valid = int(sys.argv[3]) if len(sys.argv) > 3 else 50
+    os.makedirs(out_dir, exist_ok=True)
+
+    with open(os.path.join(out_dir, "dict.txt"), "w") as f:
+        f.write("\n".join(SPECIALS + AA) + "\n")
+
+    rng = np.random.RandomState(11)
+    for split, n in [("train", n_train), ("valid", n_valid)]:
+        builder = make_builder(os.path.join(out_dir, split))
+        for _ in range(n):
+            builder.add_item(make_msa(rng))
+        builder.finalize()
+        print(f"wrote {n} MSAs to {out_dir}/{split}.bin")
+
+
+if __name__ == "__main__":
+    main()

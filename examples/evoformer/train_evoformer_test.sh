@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Smoke-train a tiny Evoformer (masked-MSA pretraining) on synthetic MSAs.
+set -e
+cd "$(dirname "$0")"
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+[ -f example_data/train.idx ] || python make_example_data.py
+python -m unicore_tpu_cli.train example_data \
+  --task msa_pretrain --loss masked_msa --arch evoformer_tiny \
+  --optimizer adam --adam-betas "(0.9, 0.999)" --adam-eps 1e-8 \
+  --clip-norm 1.0 --weight-decay 1e-4 \
+  --lr-scheduler polynomial_decay --lr 1e-3 --warmup-updates 10 \
+  --total-num-update 200 --max-update 200 --max-epoch 2 \
+  --batch-size 2 --max-msa-rows 16 --bf16 \
+  --log-interval 10 --log-format simple \
+  --save-dir ./checkpoints_test --tmp-save-dir ./checkpoints_tmp \
+  --num-workers 2 --seed 1 "$@"

@@ -141,3 +141,31 @@ def test_activation_checkpoint_training(data_dir, tmp_path):
     args = common_args(data_dir, str(tmp_path), 4) + ["--activation-checkpoint"]
     out = run_cli(args)
     assert "num_updates: 4" in out
+
+
+def test_evoformer_msa_e2e(tmp_path):
+    d = tmp_path / "msa_data"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "evoformer", "make_example_data.py"),
+            str(d), "32", "8",
+        ],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    argv = [
+        str(d),
+        "--task", "msa_pretrain", "--loss", "masked_msa",
+        "--arch", "evoformer_tiny",
+        "--optimizer", "adam", "--lr-scheduler", "fixed", "--lr", "1e-3",
+        "--warmup-updates", "0", "--max-update", "3", "--max-epoch", "2",
+        "--batch-size", "2", "--max-seq-len", "64", "--max-msa-rows", "8",
+        "--log-interval", "2", "--log-format", "simple",
+        "--save-dir", str(tmp_path / "ckpt"),
+        "--tmp-save-dir", str(tmp_path / "tmp"),
+        "--num-workers", "0", "--seed", "1", "--no-progress-bar",
+        "--required-batch-size-multiple", "1",
+    ]
+    out = run_cli(argv)
+    assert "num_updates: 3" in out

@@ -22,6 +22,13 @@ logger = logging.getLogger(__name__)
 _np_seed_lock = threading.RLock()
 
 
+def pad_to_multiple_size(size: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= size."""
+    if multiple == 1 or size % multiple == 0:
+        return size
+    return (size // multiple + 1) * multiple
+
+
 def collate_tokens(
     values: List[np.ndarray],
     pad_idx,
@@ -34,8 +41,7 @@ def collate_tokens(
     values = [np.asarray(v) for v in values]
     size = max(v.shape[0] for v in values)
     size = size if pad_to_length is None else max(size, pad_to_length)
-    if pad_to_multiple != 1 and size % pad_to_multiple != 0:
-        size = int(((size - 0.1) // pad_to_multiple + 1) * pad_to_multiple)
+    size = pad_to_multiple_size(size, pad_to_multiple)
     if values[0].dtype == np.int64 and values[0].ndim == 1:
         from . import native
 
@@ -63,8 +69,7 @@ def collate_tokens_2d(
     values = [np.asarray(v) for v in values]
     size = max(v.shape[0] for v in values)
     size = size if pad_to_length is None else max(size, pad_to_length)
-    if pad_to_multiple != 1 and size % pad_to_multiple != 0:
-        size = int(((size - 0.1) // pad_to_multiple + 1) * pad_to_multiple)
+    size = pad_to_multiple_size(size, pad_to_multiple)
     if not left_pad and values[0].ndim == 2 and values[0].dtype in (
         np.float32, np.int64,
     ):

@@ -1,0 +1,50 @@
+"""Masked-MSA cross-entropy loss for Evoformer pretraining
+(BASELINE.json config 4)."""
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+from . import register_loss
+from .unicore_loss import UnicoreLoss
+
+
+@register_loss("masked_msa")
+class MaskedMSALoss(UnicoreLoss):
+    def __init__(self, task):
+        super().__init__(task)
+        self.padding_idx = task.dictionary.pad()
+
+    def forward(self, model, params, sample, rngs=None, train=True):
+        target = sample["target"]  # (B, R, L)
+        masked = target != self.padding_idx
+        sample_size = jnp.maximum(jnp.sum(masked).astype(jnp.float32), 1.0)
+
+        out = model.apply(params, **sample["net_input"], train=train, rngs=rngs)
+        logits = out[0] if isinstance(out, tuple) else out
+
+        lprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        safe_t = jnp.where(masked, target, 0)
+        nll = -jnp.take_along_axis(lprobs, safe_t[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(jnp.where(masked, nll, 0.0))
+        logging = {
+            "loss": loss,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+            "sample_size": sample_size,
+            "seq_len": jnp.asarray(
+                target.shape[0] * target.shape[2], dtype=jnp.float32
+            ),
+        }
+        return loss, sample_size, logging
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        loss_sum = sum(log.get("loss", 0) for log in logging_outputs)
+        sample_size = sum(log.get("sample_size", 0) for log in logging_outputs)
+        metrics.log_scalar(
+            "loss", loss_sum / sample_size / jnp.log(2), sample_size, round=3
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -1,0 +1,154 @@
+"""Trainable Evoformer model for masked-MSA pretraining (BASELINE.json
+config 4: 'Uni-Fold Evoformer (MSA row/col attn + triangle multiplication)').
+
+Input embedder (AF2-style): MSA tokens -> msa channel; target (first-row)
+tokens + bucketed relative positions -> pair channel; an EvoformerStack
+refines both; a masked-MSA head predicts the corrupted positions.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu import utils
+from unicore_tpu.models import register_model, register_model_architecture
+from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.modules import EvoformerStack, LayerNorm, bert_init
+from unicore_tpu.modules.transformer_encoder import make_rp_bucket
+
+
+@register_model("evoformer")
+class EvoformerModel(BaseUnicoreModel):
+    vocab_size: int = 32
+    padding_idx: int = 0
+    num_blocks: int = 4
+    msa_dim: int = 128
+    pair_dim: int = 64
+    msa_heads: int = 8
+    pair_heads: int = 4
+    dropout: float = 0.1
+    max_seq_len: int = 256
+    rel_pos_bins: int = 32
+    remat: bool = False
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument("--num-blocks", type=int, help="evoformer blocks")
+        parser.add_argument("--msa-dim", type=int)
+        parser.add_argument("--pair-dim", type=int)
+        parser.add_argument("--msa-heads", type=int)
+        parser.add_argument("--pair-heads", type=int)
+        parser.add_argument("--dropout", type=float)
+        parser.add_argument("--max-seq-len", type=int)
+        parser.add_argument("--activation-checkpoint", action="store_true")
+
+    @classmethod
+    def build_model(cls, args, task):
+        evoformer_base_architecture(args)
+        return cls(
+            vocab_size=len(task.dictionary),
+            padding_idx=task.dictionary.pad(),
+            num_blocks=args.num_blocks,
+            msa_dim=args.msa_dim,
+            pair_dim=args.pair_dim,
+            msa_heads=args.msa_heads,
+            pair_heads=args.pair_heads,
+            dropout=args.dropout,
+            max_seq_len=args.max_seq_len,
+            remat=getattr(args, "activation_checkpoint", False),
+        )
+
+    def setup(self):
+        self.msa_embed = nn.Embed(
+            self.vocab_size, self.msa_dim, embedding_init=bert_init,
+            name="msa_embed", param_dtype=jnp.float32,
+        )
+        self.target_embed_i = nn.Embed(
+            self.vocab_size, self.pair_dim, embedding_init=bert_init,
+            name="target_embed_i", param_dtype=jnp.float32,
+        )
+        self.target_embed_j = nn.Embed(
+            self.vocab_size, self.pair_dim, embedding_init=bert_init,
+            name="target_embed_j", param_dtype=jnp.float32,
+        )
+        self.rel_pos_embed = nn.Embed(
+            self.rel_pos_bins, self.pair_dim, embedding_init=bert_init,
+            name="rel_pos_embed", param_dtype=jnp.float32,
+        )
+        # the collater rounds L up to a multiple of 8, so the bucket table
+        # must cover the padded maximum, not just max_seq_len
+        from unicore_tpu.data.data_utils import pad_to_multiple_size
+
+        self._rp_bucket = make_rp_bucket(
+            pad_to_multiple_size(self.max_seq_len, 8), self.rel_pos_bins, 128
+        )
+        self.evoformer = EvoformerStack(
+            num_blocks=self.num_blocks,
+            msa_dim=self.msa_dim,
+            pair_dim=self.pair_dim,
+            msa_heads=self.msa_heads,
+            pair_heads=self.pair_heads,
+            dropout=self.dropout,
+            remat=self.remat,
+            name="evoformer",
+        )
+        self.masked_msa_head = nn.Dense(
+            self.vocab_size, kernel_init=nn.initializers.zeros,
+            name="masked_msa_head", param_dtype=jnp.float32,
+        )
+        self.msa_norm = LayerNorm(self.msa_dim, name="msa_norm")
+
+    def __call__(self, src_msa, train: bool = False, **kwargs):
+        # src_msa: (B, R, L) int tokens; row 0 is the target sequence
+        B, R, L = src_msa.shape
+        assert L <= self._rp_bucket.shape[0], (
+            f"sequence length {L} exceeds the rel-pos table "
+            f"({self._rp_bucket.shape[0]}); raise --max-seq-len"
+        )
+        msa_mask = (src_msa != self.padding_idx).astype(jnp.float32)
+        target = src_msa[:, 0]
+        seq_ok = (target != self.padding_idx).astype(jnp.float32)
+        pair_mask = seq_ok[:, :, None] * seq_ok[:, None, :]
+
+        msa = self.msa_embed(src_msa)
+        pair = (
+            self.target_embed_i(target)[:, :, None, :]
+            + self.target_embed_j(target)[:, None, :, :]
+        )
+        rp = jnp.asarray(self._rp_bucket[:L, :L])
+        pair = pair + self.rel_pos_embed(rp)[None]
+
+        msa, pair = self.evoformer(
+            msa, pair, msa_mask=msa_mask, pair_mask=pair_mask, train=train
+        )
+        logits = self.masked_msa_head(self.msa_norm(msa))
+        return logits, pair
+
+    def init_params(self, rng, sample):
+        return self.init(
+            {"params": rng, "dropout": rng},
+            jnp.asarray(sample["net_input"]["src_msa"]),
+            train=False,
+        )
+
+
+@register_model_architecture("evoformer", "evoformer")
+def evoformer_base_architecture(args):
+    args.num_blocks = getattr(args, "num_blocks", 12)
+    args.msa_dim = getattr(args, "msa_dim", 256)
+    args.pair_dim = getattr(args, "pair_dim", 128)
+    args.msa_heads = getattr(args, "msa_heads", 8)
+    args.pair_heads = getattr(args, "pair_heads", 4)
+    args.dropout = getattr(args, "dropout", 0.1)
+    args.max_seq_len = getattr(args, "max_seq_len", 256)
+
+
+@register_model_architecture("evoformer", "evoformer_tiny")
+def evoformer_tiny_architecture(args):
+    args.num_blocks = getattr(args, "num_blocks", 2)
+    args.msa_dim = getattr(args, "msa_dim", 32)
+    args.pair_dim = getattr(args, "pair_dim", 16)
+    args.msa_heads = getattr(args, "msa_heads", 4)
+    args.pair_heads = getattr(args, "pair_heads", 4)
+    args.max_seq_len = getattr(args, "max_seq_len", 64)
+    evoformer_base_architecture(args)
