@@ -1,0 +1,95 @@
+"""Checkpoint utilities: torch .pt interop, retention pruning, merge_params."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from unicore_tpu import checkpoint_utils
+
+
+def test_torch_checkpoint_interop(tmp_path):
+    """A torch-saved Uni-Core-style checkpoint loads as a numpy pytree
+    (SURVEY.md §7 'checkpoint interop')."""
+    torch = pytest.importorskip("torch")
+    state = {
+        "model": {
+            "embed_tokens.weight": torch.randn(10, 4),
+            "encoder.layers.0.fc1.weight": torch.randn(8, 4),
+            "scalar": torch.tensor(3.0),
+            "bf16": torch.randn(4).bfloat16(),
+        },
+        "args": None,
+        "extra_state": {"epoch": 3},
+    }
+    path = str(tmp_path / "torch_ckpt.pt")
+    torch.save(state, path)
+
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    assert isinstance(loaded["model"]["embed_tokens.weight"], np.ndarray)
+    assert loaded["model"]["embed_tokens.weight"].shape == (10, 4)
+    assert str(loaded["model"]["bf16"].dtype) == "bfloat16"
+    assert loaded["extra_state"]["epoch"] == 3
+    np.testing.assert_allclose(
+        loaded["model"]["encoder.layers.0.fc1.weight"],
+        state["model"]["encoder.layers.0.fc1.weight"].numpy(),
+    )
+
+
+def test_native_checkpoint_roundtrip(tmp_path):
+    obj = {"model": {"w": np.arange(6).reshape(2, 3)}, "extra_state": {"k": 1}}
+    path = str(tmp_path / "ckpt.pt")
+    checkpoint_utils.persistent_save(obj, path)
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    np.testing.assert_array_equal(loaded["model"]["w"], obj["model"]["w"])
+
+
+def test_merge_params_strict_and_lenient():
+    params = {"a": {"w": np.zeros((2, 2))}, "b": {"w": np.zeros((3,))}}
+    ckpt = {"a": {"w": np.ones((2, 2))}}
+    with pytest.raises(KeyError):
+        checkpoint_utils.merge_params(params, ckpt, strict=True)
+    merged = checkpoint_utils.merge_params(params, ckpt, strict=False)
+    assert merged["a"]["w"].sum() == 4
+    assert merged["b"]["w"].sum() == 0
+    # shape mismatch always raises
+    with pytest.raises(ValueError):
+        checkpoint_utils.merge_params(
+            params, {"a": {"w": np.ones((5, 5))}, "b": {"w": np.zeros((3,))}},
+            strict=False,
+        )
+
+
+def test_checkpoint_paths_sorting(tmp_path):
+    for n in (3, 10, 1):
+        (tmp_path / f"checkpoint{n}.pt").write_bytes(b"x")
+    (tmp_path / "checkpoint_best.pt").write_bytes(b"x")
+    paths = checkpoint_utils.checkpoint_paths(str(tmp_path))
+    names = [os.path.basename(p) for p in paths]
+    assert names == ["checkpoint10.pt", "checkpoint3.pt", "checkpoint1.pt"]
+
+
+class _Args:
+    tmp_save_dir = None
+    save_dir = None
+    keep_interval_updates = 2
+    keep_last_epochs = -1
+    keep_best_checkpoints = -1
+    best_checkpoint_metric = "loss"
+    maximize_best_checkpoint_metric = False
+
+
+def test_retention_prunes_interval_updates(tmp_path):
+    args = _Args()
+    args.save_dir = str(tmp_path)
+    args.tmp_save_dir = str(tmp_path)
+    for upd in (100, 200, 300, 400):
+        (tmp_path / f"checkpoint_1_{upd}.pt").write_bytes(b"x")
+    src = str(tmp_path / "checkpoint_1_400.pt")
+    checkpoint_utils.ckp_copy_fun(src, [src], end_of_epoch=False, args=args)
+    remaining = sorted(os.listdir(tmp_path))
+    assert "checkpoint_1_400.pt" in remaining
+    assert "checkpoint_1_300.pt" in remaining
+    assert "checkpoint_1_200.pt" not in remaining
+    assert "checkpoint_1_100.pt" not in remaining
