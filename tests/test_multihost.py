@@ -1,0 +1,62 @@
+"""Multi-host control plane: two real processes form a cluster via
+jax.distributed.initialize and exercise every host collective
+(SURVEY.md §5.8 — the reference's NCCL rendezvous + pickle control plane)."""
+
+import subprocess
+import sys
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+rank = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n, process_id=rank)
+sys.path.insert(0, "__REPO__")
+from unicore_tpu.distributed import utils as du
+import numpy as np
+assert jax.device_count() == 2 * n
+out = du.all_reduce(np.asarray([rank + 1.0]))
+assert out.tolist() == [3.0], out
+gathered = du.all_gather_list({"rank": rank, "msg": f"hello-{rank}"})
+assert sorted(g["msg"] for g in gathered) == ["hello-0", "hello-1"]
+d = du.all_reduce_dict({"x": rank + 1.0})
+assert float(d["x"]) == 3.0
+# only the source supplies the object (reference broadcast_object contract)
+b = du.broadcast_object({"seed": 42, "blob": b"x" * 1000} if rank == 0 else None)
+assert b["seed"] == 42 and len(b["blob"]) == 1000
+print(f"RANK{rank}_OK", flush=True)
+"""
+
+
+def test_two_process_cluster_collectives(tmp_path):
+    import socket
+
+    with socket.socket() as s:  # grab a free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER.replace("__REPO__", REPO), str(r), "2", port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for r, out in enumerate(outs):
+        assert f"RANK{r}_OK" in out, f"rank {r} failed:\n{out[-3000:]}"

@@ -179,11 +179,33 @@ def all_reduce_dict(data: Dict[str, Any], device=None, group=None) -> Dict[str, 
 
 def broadcast_object(obj, src_rank=0, group=None):
     """Broadcast a picklable object from one host to all
-    (reference utils.py:447-495)."""
+    (reference utils.py:447-495).
+
+    Only the source rank needs to supply ``obj`` (others pass anything);
+    the payload travels as bytes in two phases — length, then buffer — so
+    pytree structures never need to match across hosts (passing mismatched
+    structures to ``broadcast_one_to_all`` directly deadlocks).
+    """
     if jax.process_count() == 1:
         return obj
+    import pickle
+
     from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(
-        obj, is_source=jax.process_index() == src_rank
+    is_source = jax.process_index() == src_rank
+    if is_source:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    else:
+        payload = np.zeros((0,), dtype=np.uint8)
+    # length travels as 8 uint8 bytes: an int64 array would be silently
+    # canonicalized to int32 under the default x64-disabled config, wrapping
+    # for payloads >= 2 GiB (same encoding as all_gather_list's header)
+    header = np.frombuffer(
+        np.asarray([len(payload)], dtype=np.uint64).tobytes(), dtype=np.uint8
     )
+    n_bytes = multihost_utils.broadcast_one_to_all(header, is_source=is_source)
+    n = int(np.frombuffer(np.asarray(n_bytes, dtype=np.uint8).tobytes(),
+                          dtype=np.uint64)[0])
+    buf = payload if is_source else np.zeros((n,), dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(np.asarray(out).tobytes())
