@@ -1,0 +1,80 @@
+"""Pallas fused LayerNorm/RMSNorm vs jnp reference — the dim/dtype sweep
+analogue of the reference's LN kernel coverage (FUSED_LAYER_NORM_SUPPORT_DIM,
+modules/layer_norm.py:48 — here any dim works, no whitelist)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.ops import flash_attention as fa_mod
+from unicore_tpu.ops.fused_norm import fused_layer_norm, fused_rms_norm
+
+fa_mod.set_interpret(jax.default_backend() != "tpu")
+
+
+def ln_ref(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+def rms_ref(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = (xf ** 2).mean(-1, keepdims=True)
+    return ((xf * jax.lax.rsqrt(ms + eps)) * w).astype(x.dtype)
+
+
+@pytest.mark.parametrize("D", [64, 192, 768, 1024])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_forward(D, dtype):
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 24, D), dtype) * 3 + 1
+    w = jax.random.normal(jax.random.PRNGKey(1), (D,), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(2), (D,), jnp.float32)
+    out = fused_layer_norm(x, w, b)
+    ref = ln_ref(x, w, b)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    assert float(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)).max()) < tol
+
+
+def test_layer_norm_gradients():
+    D = 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, D)) * 2
+    w = jax.random.normal(jax.random.PRNGKey(1), (D,))
+    b = jax.random.normal(jax.random.PRNGKey(2), (D,))
+
+    g1 = jax.grad(lambda *a: jnp.sum(fused_layer_norm(*a) ** 2), argnums=(0, 1, 2))(
+        x, w, b
+    )
+    g2 = jax.grad(lambda *a: jnp.sum(ln_ref(*a) ** 2), argnums=(0, 1, 2))(x, w, b)
+    for name, a, r in zip(["dx", "dw", "db"], g1, g2):
+        scale = max(1.0, float(jnp.abs(r).max()))
+        assert float(jnp.abs(a - r).max()) / scale < 1e-5, name
+
+
+def test_rms_norm_forward_and_grad():
+    D = 512
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, D)) * 2
+    w = jax.random.normal(jax.random.PRNGKey(1), (D,))
+    out = fused_rms_norm(x, w)
+    ref = rms_ref(x, w)
+    assert float(jnp.abs(out - ref).max()) < 1e-5
+
+    g1 = jax.grad(lambda *a: jnp.sum(fused_rms_norm(*a) ** 2), argnums=(0, 1))(x, w)
+    g2 = jax.grad(lambda *a: jnp.sum(rms_ref(*a) ** 2), argnums=(0, 1))(x, w)
+    for name, a, r in zip(["dx", "dw"], g1, g2):
+        scale = max(1.0, float(jnp.abs(r).max()))
+        assert float(jnp.abs(a - r).max()) / scale < 1e-5, name
+
+
+def test_odd_row_counts():
+    # N not divisible by the preferred row block: falls back to smaller blocks
+    D = 128
+    x = jax.random.normal(jax.random.PRNGKey(0), (7, D))
+    w = jnp.ones((D,))
+    b = jnp.zeros((D,))
+    out = fused_layer_norm(x, w, b)
+    ref = ln_ref(x, w, b)
+    assert float(jnp.abs(out - ref).max()) < 1e-5

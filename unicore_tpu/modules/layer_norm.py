@@ -18,21 +18,26 @@ class LayerNorm(nn.Module):
     normalized_shape: int
     eps: float = 1e-5
     elementwise_affine: bool = True
+    use_pallas: bool = False  # fused kernel (ops/fused_norm.py); default XLA
 
     @nn.compact
     def __call__(self, x):
         assert self.elementwise_affine
-        dtype = x.dtype
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
-        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
         weight = self.param(
             "weight", nn.initializers.ones, (self.normalized_shape,), jnp.float32
         )
         bias = self.param(
             "bias", nn.initializers.zeros, (self.normalized_shape,), jnp.float32
         )
+        if self.use_pallas:
+            from unicore_tpu.ops.fused_norm import fused_layer_norm
+
+            return fused_layer_norm(x, weight, bias, eps=self.eps)
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
         y = y * weight + bias
         return y.astype(dtype)
 
@@ -44,16 +49,21 @@ class RMSNorm(nn.Module):
     normalized_shape: int
     eps: float = 1e-6
     elementwise_affine: bool = True
+    use_pallas: bool = False  # fused kernel (ops/fused_norm.py); default XLA
 
     @nn.compact
     def __call__(self, x):
         assert self.elementwise_affine
+        weight = self.param(
+            "weight", nn.initializers.ones, (self.normalized_shape,), jnp.float32
+        )
+        if self.use_pallas:
+            from unicore_tpu.ops.fused_norm import fused_rms_norm
+
+            return fused_rms_norm(x, weight, eps=self.eps)
         dtype = x.dtype
         xf = x.astype(jnp.float32)
         ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
         y = xf / jnp.sqrt(ms + self.eps)
-        weight = self.param(
-            "weight", nn.initializers.ones, (self.normalized_shape,), jnp.float32
-        )
         y = y * weight
         return y.astype(dtype)

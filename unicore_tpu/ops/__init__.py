@@ -1,6 +1,15 @@
 """TPU op library — jnp reference implementations with Pallas fast paths.
 
 Counterpart of the reference's csrc/ CUDA extensions (SURVEY.md §2.2).
+
+NOTE: ``unicore_tpu.ops.flash_attention`` stays a MODULE (its entry points
+are ``flash_attention.flash_attention`` / ``flash_attention.mha_reference``
+/ ``flash_attention.set_interpret``); re-exporting the function here would
+shadow the submodule for ``from unicore_tpu.ops import flash_attention``
+consumers.
 """
 
+from . import flash_attention  # noqa  (module, not the function)
 from .softmax_dropout import softmax_dropout  # noqa
+from .rounding import fp32_to_bf16_sr, tree_fp32_to_bf16_sr  # noqa
+from .fused_norm import fused_layer_norm, fused_rms_norm  # noqa
