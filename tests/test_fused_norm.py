@@ -78,3 +78,22 @@ def test_odd_row_counts():
     out = fused_layer_norm(x, w, b)
     ref = ln_ref(x, w, b)
     assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+@pytest.mark.parametrize("module_cls", ["ln", "rms"])
+def test_module_use_pallas_matches_xla(module_cls):
+    from unicore_tpu.modules import LayerNorm, RMSNorm
+
+    D = 192
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 7, D)) * 2
+    if module_cls == "ln":
+        m_p, m_x = LayerNorm(D, use_pallas=True), LayerNorm(D, use_pallas=False)
+    else:
+        m_p, m_x = RMSNorm(D, use_pallas=True), RMSNorm(D, use_pallas=False)
+    p = m_p.init(jax.random.PRNGKey(1), x)
+    o1, o2 = m_p.apply(p, x), m_x.apply(p, x)
+    assert float(jnp.abs(o1 - o2).max()) < 1e-5
+    g1 = jax.grad(lambda pp: jnp.sum(m_p.apply(pp, x) ** 2))(p)
+    g2 = jax.grad(lambda pp: jnp.sum(m_x.apply(pp, x) ** 2))(p)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        assert float(jnp.abs(a - b).max()) < 1e-4

@@ -1,0 +1,118 @@
+"""Module-level behavior tests: decoder causality, rel-pos buckets,
+pre/post-LN, return_attn."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.modules import (
+    TransformerDecoder,
+    TransformerEncoder,
+    relative_position_bucket,
+)
+
+
+def test_decoder_causality():
+    """Autoregressive decoder: output at position i must not depend on
+    inputs at positions > i."""
+    B, L, E = 1, 16, 32
+    dec = TransformerDecoder(
+        decoder_layers=2, embed_dim=E, ffn_embed_dim=64, attention_heads=4,
+        max_seq_len=L, auto_regressive=True, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0,
+    )
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    params = dec.init({"params": jax.random.PRNGKey(1)}, emb)
+    out1 = dec.apply(params, emb)
+    # non-uniform perturbation of the LAST position only (a uniform shift
+    # would be removed by the embedding LayerNorm's mean subtraction)
+    noise = jax.random.normal(jax.random.PRNGKey(9), (E,)) * 10.0
+    emb2 = emb.at[0, -1].add(noise)
+    out2 = dec.apply(params, emb2)
+    # positions before the last must be identical
+    assert float(jnp.abs(out1[0, :-1] - out2[0, :-1]).max()) == 0.0
+    # the last position must change
+    assert float(jnp.abs(out1[0, -1] - out2[0, -1]).max()) > 1e-3
+
+
+def test_decoder_non_autoregressive_sees_future():
+    B, L, E = 1, 16, 32
+    dec = TransformerDecoder(
+        decoder_layers=1, embed_dim=E, ffn_embed_dim=64, attention_heads=4,
+        max_seq_len=L, auto_regressive=False, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0,
+    )
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    params = dec.init({"params": jax.random.PRNGKey(1)}, emb)
+    out1 = dec.apply(params, emb)
+    noise = jax.random.normal(jax.random.PRNGKey(9), (E,)) * 10.0
+    out2 = dec.apply(params, emb.at[0, -1].add(noise))
+    assert float(jnp.abs(out1[0, :-1] - out2[0, :-1]).max()) > 1e-4
+
+
+def test_decoder_cross_attention_uses_encoder_out():
+    B, L, E = 1, 8, 32
+    dec = TransformerDecoder(
+        decoder_layers=1, embed_dim=E, ffn_embed_dim=64, attention_heads=4,
+        max_seq_len=L, emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+    )
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    enc_out = jax.random.normal(jax.random.PRNGKey(1), (B, L, E))
+    params = dec.init({"params": jax.random.PRNGKey(2)}, emb, encoder_out=enc_out)
+    o1 = dec.apply(params, emb, encoder_out=enc_out)
+    o2 = dec.apply(params, emb, encoder_out=enc_out + 1.0)
+    assert float(jnp.abs(o1 - o2).max()) > 1e-4
+
+
+def test_relative_position_bucket_properties():
+    rp = np.arange(-256, 257)
+    buckets = relative_position_bucket(rp, num_buckets=32, max_distance=128)
+    # symmetric sign, zero at center
+    assert buckets[256] == 0
+    assert (buckets[:256] <= 0).all() and (buckets[257:] >= 0).all()
+    # bounded by the bucket count
+    assert buckets.max() <= 15 and buckets.min() >= -15
+    # small offsets are exact
+    assert buckets[256 + 3] == 3 and buckets[256 - 3] == -3
+
+
+@pytest.mark.parametrize("post_ln", [False, True])
+def test_encoder_pre_post_ln_both_train(post_ln):
+    B, L, E = 2, 16, 32
+    enc = TransformerEncoder(
+        encoder_layers=2, embed_dim=E, ffn_embed_dim=64, attention_heads=4,
+        max_seq_len=L, post_ln=post_ln,
+    )
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    params = enc.init(
+        {"params": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)}, emb
+    )
+    loss = lambda p: jnp.sum(
+        enc.apply(p, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)}) ** 2
+    )
+    l, g = jax.value_and_grad(loss)(params)
+    gn = np.sqrt(
+        sum(float(jnp.sum(x ** 2)) for x in jax.tree_util.tree_leaves(g))
+    )
+    assert np.isfinite(float(l)) and np.isfinite(gn) and gn > 0
+
+
+def test_encoder_layer_return_attn():
+    from unicore_tpu.modules import TransformerEncoderLayer
+
+    B, L, E, H = 2, 16, 32, 4
+    layer = TransformerEncoderLayer(
+        embed_dim=E, ffn_embed_dim=64, attention_heads=H,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    params = layer.init({"params": jax.random.PRNGKey(1)}, x)
+    out, attn_weights, attn_probs = layer.apply(
+        params, x, None, None, True, False
+    )
+    assert out.shape == (B, L, E)
+    assert attn_weights.shape == (B, H, L, L)
+    # probabilities sum to 1 along keys
+    sums = jnp.sum(attn_probs.astype(jnp.float32), axis=-1)
+    np.testing.assert_allclose(np.asarray(sums), 1.0, atol=1e-3)

@@ -1,0 +1,107 @@
+"""Fused softmax(+mask)(+bias)(+dropout) numerics — mirrors the reference's
+single test file (/root/reference/tests/test_softmax.py): last-dim sweep
+{64..2048} x dtypes {fp32, bf16}, forward AND gradients (incl. grad wrt
+bias), plus the two 5-D broadcast layouts used by Uni-Fold triangle
+attention (test_softmax.py:81-170).  Tolerance mirrors the reference's
+1e-3 max-abs bound (scaled for bf16).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.ops.softmax_dropout import softmax_dropout
+
+
+def ref_softmax(x, mask=None, bias=None):
+    x = x.astype(jnp.float32)
+    if mask is not None:
+        x = x + mask.astype(jnp.float32)
+    if bias is not None:
+        x = x + bias.astype(jnp.float32)
+    return jax.nn.softmax(x, axis=-1)
+
+
+@pytest.mark.parametrize("last_dim", [64, 128, 256, 512, 1024, 2048])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_forward_and_grads_dim_sweep(last_dim, dtype):
+    B, Q = 4, 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, Q, last_dim), dtype)
+    bias = jax.random.normal(jax.random.PRNGKey(1), (1, Q, last_dim), jnp.float32)
+    mask = jnp.where(
+        jax.random.bernoulli(jax.random.PRNGKey(2), 0.2, (B, 1, last_dim)),
+        -1e9, 0.0,
+    )
+
+    out = softmax_dropout(x, 0.0, is_training=False, mask=mask, bias=bias)
+    ref = ref_softmax(x, mask, bias).astype(dtype)
+    tol = 1e-2 if dtype == jnp.bfloat16 else 1e-3
+    assert float(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)).max()) < tol
+
+    if dtype == jnp.float32:
+        g1 = jax.grad(
+            lambda x_, b_: jnp.sum(
+                softmax_dropout(x_, 0.0, is_training=False, mask=mask, bias=b_) ** 2
+            ),
+            argnums=(0, 1),
+        )(x, bias)
+        g2 = jax.grad(
+            lambda x_, b_: jnp.sum(ref_softmax(x_, mask, b_) ** 2), argnums=(0, 1)
+        )(x, bias)
+        for name, a, r in zip(["dx", "dbias"], g1, g2):
+            scale = max(1.0, float(jnp.abs(r).max()))
+            assert float(jnp.abs(a - r).max()) / scale < 1e-5, name
+            assert a.shape == r.shape  # bias grad reduced over broadcast dims
+
+
+@pytest.mark.parametrize(
+    "bias_shape",
+    [
+        # the two Uni-Fold triangle-attention layouts (reference
+        # test_softmax.py:81-170): bias broadcast over a leading grouping dim
+        (1, 4, 8, 32, 32),
+        (2, 1, 8, 32, 32),
+    ],
+)
+def test_unifold_5d_broadcast_layouts(bias_shape):
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 32, 32))
+    bias = jax.random.normal(jax.random.PRNGKey(1), bias_shape)
+    out = softmax_dropout(x, 0.0, is_training=False, bias=bias)
+    ref = ref_softmax(x, bias=jnp.broadcast_to(bias, x.shape))
+    assert float(jnp.abs(out - ref).max()) < 1e-5
+
+    # bias grad keeps the broadcast shape (reference sums over repeat dims,
+    # modules/softmax_dropout.py:44-48)
+    db = jax.grad(
+        lambda b_: jnp.sum(softmax_dropout(x, 0.0, is_training=False, bias=b_) ** 2)
+    )(bias)
+    assert db.shape == bias_shape
+    db_ref = jax.grad(
+        lambda b_: jnp.sum(ref_softmax(x, bias=jnp.broadcast_to(b_, x.shape)) ** 2)
+    )(bias)
+    assert float(jnp.abs(db - db_ref).max()) < 1e-4
+
+
+def test_divisible_leading_bias_repeat():
+    """The reference's (B*H) %% G == 0 repeat rule (interface.cpp:37-48)."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 16, 64))
+    bias = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 64))
+    out = softmax_dropout(x, 0.0, is_training=False, bias=bias)
+    ref = ref_softmax(x, bias=jnp.tile(bias, (3, 1, 1)))
+    assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+def test_dropout_statistics():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 32, 128))
+    rng = jax.random.PRNGKey(7)
+    out = softmax_dropout(x, 0.5, is_training=True, dropout_rng=rng)
+    zeros = float(jnp.mean(out == 0.0))
+    assert 0.4 < zeros < 0.6
+    # rows still sum to ~1 in expectation (inverted dropout)
+    sums = jnp.sum(out, axis=-1)
+    assert abs(float(jnp.mean(sums)) - 1.0) < 0.1
+    # eval mode: no dropout applied
+    out_eval = softmax_dropout(x, 0.5, is_training=False)
+    assert float(jnp.mean(out_eval == 0.0)) < 0.01

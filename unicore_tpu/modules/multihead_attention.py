@@ -85,9 +85,9 @@ def _bias_min_broadcast(bias, bsz, num_heads, tgt_len, src_len):
 def _flash_ok(tgt_len, src_len, head_dim, dtype):
     """Shape/backend gate for the Pallas kernel: 128-aligned sequence
     blocks on a TPU backend (or interpret mode for tests)."""
-    from unicore_tpu.ops import flash_attention as fa_mod
+    from unicore_tpu.ops._pallas import interpret_enabled
 
-    on_tpu = jax.default_backend() in ("tpu", "axon") or fa_mod._INTERPRET
+    on_tpu = jax.default_backend() in ("tpu", "axon") or interpret_enabled()
     return (
         on_tpu
         and tgt_len % 128 == 0

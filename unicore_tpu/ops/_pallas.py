@@ -1,0 +1,22 @@
+"""Shared Pallas plumbing: the interpret-mode switch used by every kernel
+in ops/ (interpret=True runs kernels on any backend, e.g. the CPU test
+platform; env: UNICORE_TPU_PALLAS_INTERPRET=1)."""
+
+import os
+
+from jax.experimental import pallas as pl
+
+_INTERPRET = os.environ.get("UNICORE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+
+def set_interpret(enabled: bool):
+    global _INTERPRET
+    _INTERPRET = enabled
+
+
+def interpret_enabled() -> bool:
+    return _INTERPRET
+
+
+def pallas_call(*args, **kwargs):
+    return pl.pallas_call(*args, interpret=_INTERPRET, **kwargs)

@@ -39,17 +39,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # big finite: -inf minus -inf would NaN the rescale path
 
 # interpret mode runs the kernels on any backend (CPU tests); dropout uses
-# TPU-only PRNG primitives and stays TPU-gated
-_INTERPRET = os.environ.get("UNICORE_TPU_PALLAS_INTERPRET", "0") == "1"
-
-
-def set_interpret(enabled: bool):
-    global _INTERPRET
-    _INTERPRET = enabled
-
-
-def _pallas_call(*args, **kwargs):
-    return pl.pallas_call(*args, interpret=_INTERPRET, **kwargs)
+# TPU-only PRNG primitives and stays TPU-gated.  The switch is shared by all
+# ops/ kernels (ops/_pallas.py); these aliases keep the public API.
+from ._pallas import interpret_enabled, pallas_call as _pallas_call, set_interpret
 
 
 def _cdiv(a, b):

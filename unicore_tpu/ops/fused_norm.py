@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flash_attention import _pallas_call  # shares the interpret-mode switch
+from ._pallas import pallas_call as _pallas_call
 
 
 def _pick_rows(n, preferred=256):
@@ -132,7 +132,6 @@ def _ln_dx_kernel(x_ref, w_ref, m_ref, r_ref, dy_ref, dx_ref, *, rms):
     mean, rstd = m_ref[...], r_ref[...]
     xhat = (x - mean) * rstd
     wdy = dy * w
-    D = x.shape[1]
     if rms:
         c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
         dx = (wdy - xhat * c2) * rstd
