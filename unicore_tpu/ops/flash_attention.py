@@ -28,7 +28,6 @@ in the input dtype on the MXU with fp32 accumulation.
 """
 
 import functools
-import os
 from typing import Optional
 
 import jax

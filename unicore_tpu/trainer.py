@@ -384,6 +384,56 @@ class Trainer(object):
                 return new_state, accumulate(macc, step_metrics)
 
             fn = train_step
+        elif name == "scan_step":
+
+            @partial(jax.jit, donate_argnums=(0,) if donate else ())
+            def scan_step(state, stacked, scalars, macc):
+                """Whole grad-accumulation update in ONE program: micro-
+                batches stacked on a leading axis, lax.scan accumulates fp32
+                grads (SURVEY.md §7: 'micro-batch scan'); then the shared
+                apply path."""
+
+                def body(carry, xs):
+                    acc_grads, acc_ss, acc_log = carry
+                    sample_k, micro_i = xs
+                    rng = make_rng(scalars, micro_i)
+                    grads, ss, log = self._forward_backward(
+                        state["params"], sample_k, rng, state["loss_scale"],
+                        scalars["weight"],
+                    )
+                    acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
+                    new_log = {k: acc_log[k] + log[k] for k in acc_log}
+                    return (acc_grads, acc_ss + ss, new_log), None
+
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+                )
+                # trace one body call to learn the logging keys
+                probe_rng = make_rng(scalars, 0)
+                _, _, probe_log = jax.eval_shape(
+                    lambda p, s: self._forward_backward(
+                        p, s, probe_rng, state["loss_scale"], scalars["weight"]
+                    ),
+                    state["params"],
+                    jax.tree_util.tree_map(lambda x: x[0], stacked),
+                )
+                zero_log = {
+                    k: jnp.zeros(v.shape, jnp.float32)
+                    for k, v in probe_log.items()
+                }
+                n_micro = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+                (grads, ss, log), _ = jax.lax.scan(
+                    body,
+                    (zero_grads, jnp.zeros((), jnp.float32), zero_log),
+                    (stacked, jnp.arange(n_micro, dtype=jnp.int32)),
+                )
+                rng = make_rng(scalars, 0)
+                new_state, step_metrics = self._apply_update(
+                    state, grads, ss, log, scalars["lr"], rng
+                )
+                return new_state, accumulate(macc, step_metrics)
+
+            fn = scan_step
         elif name == "micro_step":
 
             @partial(jax.jit, donate_argnums=(3,) if donate else ())
@@ -473,17 +523,25 @@ class Trainer(object):
                 state, sample, self._step_scalars(0, weight), self._macc
             )
         else:
-            acc = None
-            micro = self._get_jit("micro_step")
-            for i, s in enumerate(samples):
-                sample, weight = self._prepare_sample_or_dummy(s)
-                acc = micro(
-                    state["params"], state["loss_scale"], sample, acc,
-                    self._step_scalars(i, weight),
+            stacked = self._try_stack_microbatches(samples)
+            if stacked is not None:
+                # all micro-batches share shapes: ONE compiled program scans
+                # the whole accumulation (no per-micro-batch dispatch)
+                new_state, self._macc = self._get_jit("scan_step")(
+                    state, stacked, self._step_scalars(0), self._macc
                 )
-            new_state, self._macc = self._get_jit("apply_step")(
-                state, acc, self._step_scalars(0), self._macc
-            )
+            else:
+                acc = None
+                micro = self._get_jit("micro_step")
+                for i, s in enumerate(samples):
+                    sample, weight = self._prepare_sample_or_dummy(s)
+                    acc = micro(
+                        state["params"], state["loss_scale"], sample, acc,
+                        self._step_scalars(i, weight),
+                    )
+                new_state, self._macc = self._get_jit("apply_step")(
+                    state, acc, self._step_scalars(0), self._macc
+                )
 
         self._state = new_state
         self._cached_eval_params = None
@@ -584,6 +642,57 @@ class Trainer(object):
         sharding = self._batch_sharding if divisible else self._replicated
         sample = utils.apply_to_sample(_narrow_dtype, sample)
         return utils.move_to_device(sample, sharding)
+
+    def _try_stack_microbatches(self, samples):
+        """Stack same-shaped micro-batches on a HOST leading axis for the
+        scan path (device layout: micro axis replicated, batch dim sharded
+        over 'data'); returns None when shapes differ or any batch is a
+        dummy."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from unicore_tpu.parallel import DATA_AXIS
+
+        if jax.process_count() > 1:
+            # per-host shape/dummy differences at epoch tails would make
+            # hosts run different program sequences and deadlock the psum;
+            # multi-host keeps the per-micro-batch path (same programs on
+            # every host via the dummy-batch protocol)
+            return None
+        if any(s is None or len(s) == 0 for s in samples):
+            return None
+        flats = [jax.tree_util.tree_leaves(s) for s in samples]
+        def sig(leaves):
+            out = []
+            for l in leaves:
+                if not hasattr(l, "shape") or getattr(l, "ndim", 0) < 1:
+                    return None  # scalar leaf: cannot stack/shard -> fall back
+                out.append((l.shape, str(l.dtype)))
+            return out
+        shapes0 = sig(flats[0])
+        if shapes0 is None:
+            return None
+        for f in flats[1:]:
+            if sig(f) != shapes0:
+                return None
+        host = [
+            utils.apply_to_sample(_narrow_dtype, s) for s in samples
+        ]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs], axis=0), *host
+        )
+        data_size = self.mesh.shape[DATA_AXIS]
+        divisible = all(
+            leaf.shape[1] % data_size == 0
+            for leaf in jax.tree_util.tree_leaves(stacked)
+        )
+        sharding = (
+            NamedSharding(self.mesh, P(None, DATA_AXIS))
+            if divisible
+            else self._replicated
+        )
+        if self._dummy_batch is None:
+            self._dummy_batch = self._prepare_sample(samples[0])
+        return utils.move_to_device(stacked, sharding)
 
     def _prepare_sample_or_dummy(self, sample):
         """Empty shard-tail batches become weight-0 dummy steps so all hosts

@@ -156,6 +156,31 @@ def get_activation_fn(activation: str) -> Callable:
 # ---------------------------------------------------------------------------
 
 # ---------------------------------------------------------------------------
+# activation checkpointing (reference checkpoint_sequential, utils.py:306-333)
+# ---------------------------------------------------------------------------
+
+def checkpoint_sequential(functions, input, segments=None):
+    """Run a list of functions sequentially, rematerializing each segment's
+    activations in the backward pass (jax.checkpoint per segment — the TPU
+    form of the reference's torch.utils.checkpoint chaining)."""
+    if segments is None:
+        segments = len(functions)
+    segments = max(1, min(segments, len(functions)))
+    per = (len(functions) + segments - 1) // segments
+    x = input
+    for start in range(0, len(functions), per):
+        chunk = functions[start:start + per]
+
+        def run_chunk(y, fns=tuple(chunk)):
+            for fn in fns:
+                y = fn(y)
+            return y
+
+        x = jax.checkpoint(run_chunk)(x)
+    return x
+
+
+# ---------------------------------------------------------------------------
 # Uni-Fold tensor helpers (reference utils.py:336-411)
 # ---------------------------------------------------------------------------
 
