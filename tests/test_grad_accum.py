@@ -1,8 +1,6 @@
 """Grad-accumulation: the stacked-scan path (one compiled program) must
 match sequential micro-steps bit-for-bit."""
 
-import os
-
 import jax
 import numpy as np
 import jax.numpy as jnp
@@ -26,7 +24,6 @@ class T(UnicoreTask):
         def pad(self): return 1
     dictionary=_D()
 
-rng=np.random.RandomState(0)
 def mk(shape_seed):
     r = np.random.RandomState(shape_seed)
     tok = r.randint(4, 64, size=(8, 32)).astype(np.int64)

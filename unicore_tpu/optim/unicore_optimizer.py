@@ -48,13 +48,14 @@ def _path_str(path) -> str:
 
 def make_decay_mask(params, no_decay_names=("bias", "layer_norm", "layernorm")):
     """True where weight decay applies (reference separate_decay_params,
-    fp16_optimizer.py:16-43: bias / rank<=1 / named params excluded)."""
+    fp16_optimizer.py:16-43: bias / rank<=1 / named params excluded;
+    --no-weight-decay-names adds user-specified name substrings)."""
 
     def mask_leaf(path, leaf):
         name = _path_str(path).lower()
         if leaf.ndim <= 1:
             return False
-        if any(nd in name for nd in no_decay_names):
+        if any(nd in name for nd in no_decay_names if nd):
             return False
         return True
 
@@ -140,7 +141,14 @@ class UnicoreOptimizer(object):
             inv = 1.0 / jnp.asarray(grad_scale, dtype=jnp.float32)
             grads32 = jax.tree_util.tree_map(lambda g: g * inv, grads32)
 
-        decay_mask = make_decay_mask(params)
+        extra = tuple(
+            n.strip().lower()
+            for n in getattr(self.args, "no_weight_decay_names", "").split(",")
+            if n.strip()
+        )
+        decay_mask = make_decay_mask(
+            params, ("bias", "layer_norm", "layernorm") + extra
+        )
         lr = jnp.asarray(lr, dtype=jnp.float32)
         new_master, new_slots = self._apply_update(
             grads32, state["slots"], master, lr, step, decay_mask

@@ -306,6 +306,9 @@ def add_optimization_args(parser):
                        help="clip threshold of gradients")
     group.add_argument("--per-sample-clip-norm", default=0.0, type=float, metavar="PNORM",
                        help="clip threshold of gradients, before gradient sync over workers")
+    group.add_argument("--no-weight-decay-names", default="", type=str,
+                       help="comma separated parameter-name substrings excluded from "
+                            "weight decay (bias and 1-dim params are always excluded)")
     group.add_argument("--update-freq", default="1", metavar="N1,N2,...,N_K",
                        type=lambda uf: utils.eval_str_list(uf, type=int),
                        help="update parameters every N_i batches, when in epoch i")

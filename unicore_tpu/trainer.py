@@ -660,6 +660,9 @@ class Trainer(object):
             return None
         if any(s is None or len(s) == 0 for s in samples):
             return None
+        structs = [jax.tree_util.tree_structure(s) for s in samples]
+        if any(st != structs[0] for st in structs[1:]):
+            return None
         flats = [jax.tree_util.tree_leaves(s) for s in samples]
         def sig(leaves):
             out = []

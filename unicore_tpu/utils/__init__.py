@@ -163,6 +163,8 @@ def checkpoint_sequential(functions, input, segments=None):
     """Run a list of functions sequentially, rematerializing each segment's
     activations in the backward pass (jax.checkpoint per segment — the TPU
     form of the reference's torch.utils.checkpoint chaining)."""
+    if not functions:
+        return input
     if segments is None:
         segments = len(functions)
     segments = max(1, min(segments, len(functions)))
