@@ -1,0 +1,87 @@
+"""fp16 dynamic loss scaling: overflowed steps are skipped in-jit and the
+scale shrinks (the branchless form of the reference's OverflowError skip,
+dynamic_loss_scaler.py + trainer.py:749-755)."""
+
+from argparse import Namespace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.losses import LOSS_REGISTRY
+from unicore_tpu.models.bert import BertModel
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+from unicore_tpu.trainer import Trainer
+
+
+class _Task(UnicoreTask):
+    class _D:
+        def pad(self):
+            return 1
+
+    dictionary = _D()
+
+
+def make_trainer(init_scale):
+    args = Namespace(
+        seed=1, bf16=False, fp16=True, bf16_sr=False, allreduce_fp32_grad=False,
+        fp16_init_scale=init_scale, fp16_scale_window=4, min_loss_scale=1e-4,
+        clip_norm=0.0, per_sample_clip_norm=0.0, data_parallel_size=-1,
+        model_parallel_size=1, seq_parallel_size=1, pipeline_parallel_size=1,
+        expert_parallel_size=1, zero_shard_optimizer=False, optimizer="adam",
+        lr_scheduler="fixed", lr=[1e-3], adam_betas="(0.9, 0.999)",
+        adam_eps=1e-8, weight_decay=0.0, force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, ema_decay=-1.0, validate_with_ema=False,
+        max_update=100, update_freq=[1], donate_train_state=False,
+        no_weight_decay_names="",
+    )
+    model = BertModel(
+        vocab_size=64, padding_idx=1, encoder_layers=1, encoder_embed_dim=32,
+        encoder_ffn_embed_dim=64, encoder_attention_heads=4, max_seq_len=32,
+        post_ln=True, dropout=0.0, emb_dropout=0.0, attention_dropout=0.0,
+    )
+    return Trainer(args, _Task(args), model, LOSS_REGISTRY["masked_lm"](_Task(args)))
+
+
+def make_sample(seed=0):
+    r = np.random.RandomState(seed)
+    tok = r.randint(4, 64, size=(8, 32)).astype(np.int64)
+    tgt = np.where(r.rand(8, 32) < 0.2, tok, 1).astype(np.int64)
+    return {"net_input": {"src_tokens": tok}, "target": tgt}
+
+
+def test_overflow_skips_update_and_shrinks_scale():
+    # enormous init scale: scaled loss overflows fp32 grads -> non-finite
+    tr = make_trainer(init_scale=2.0 ** 120)
+    tr.init_state(make_sample())
+    p0 = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(tr._state["params"])[0])
+    )
+    tr.train_step([make_sample()])
+    scale_after = float(jax.device_get(tr._state["loss_scale"]))
+    p1 = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(tr._state["params"])[0])
+    )
+    assert scale_after == 2.0 ** 119  # halved on overflow
+    np.testing.assert_array_equal(p0, p1)  # update skipped
+    macc = {k: float(v) for k, v in jax.device_get(tr._macc).items()}
+    assert macc["overflow"] == 1.0
+
+
+def test_normal_fp16_training_grows_scale():
+    tr = make_trainer(init_scale=4.0)
+    tr.init_state(make_sample())
+    p0 = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(tr._state["params"])[0])
+    )
+    for i in range(4):  # scale_window=4 clean steps -> scale doubles
+        tr.train_step([make_sample(i)])
+    scale = float(jax.device_get(tr._state["loss_scale"]))
+    p1 = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(tr._state["params"])[0])
+    )
+    assert scale == 8.0
+    assert np.abs(p1 - p0).max() > 0  # updates applied
+    macc = {k: float(v) for k, v in jax.device_get(tr._macc).items()}
+    assert macc["overflow"] == 0.0

@@ -591,6 +591,14 @@ class Trainer(object):
             metrics.log_scalar(
                 "loss_scale", loss_scale_sum / n, n, priority=700, round=4
             )
+        # device free-HBM health scalar (reference trainer.py:1086-1124
+        # logs gb_free); one host query per flush interval
+        mem = utils.get_device_memory_info()
+        if mem:
+            stats = next(iter(mem.values()))
+            if stats.get("bytes_limit"):
+                gb_free = (stats["bytes_limit"] - stats["bytes_in_use"]) / 1024 ** 3
+                metrics.log_scalar("gb_free", gb_free, weight=0, priority=1500, round=1)
         self.task.reduce_metrics([delta], self.loss)
 
     def valid_step(self, sample, seed=None):
