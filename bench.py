@@ -23,7 +23,32 @@ from argparse import Namespace
 import numpy as np
 
 
+def _backend_watchdog(timeout_s=180):
+    """The axon tunnel can die in a way that makes jax.devices() hang
+    forever; bound backend init so the caller gets a clean failure instead
+    of an eternal hang."""
+    import threading
+
+    ready = threading.Event()
+
+    def probe():
+        import jax
+
+        jax.devices()
+        ready.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not ready.wait(timeout_s):
+        sys.stderr.write(
+            f"bench: accelerator backend not ready after {timeout_s}s "
+            "(tunnel down?); aborting\n"
+        )
+        os._exit(3)
+
+
 def main():
+    _backend_watchdog()
     import jax
 
     from unicore_tpu.losses import LOSS_REGISTRY
