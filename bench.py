@@ -29,21 +29,28 @@ def _backend_watchdog(timeout_s=180):
     of an eternal hang."""
     import threading
 
-    ready = threading.Event()
+    done = threading.Event()
+    err = []
 
     def probe():
-        import jax
+        try:
+            import jax
 
-        jax.devices()
-        ready.set()
+            jax.devices()
+        except Exception as e:  # fail fast with the real error
+            err.append(e)
+        done.set()
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    if not ready.wait(timeout_s):
+    if not done.wait(timeout_s):
         sys.stderr.write(
             f"bench: accelerator backend not ready after {timeout_s}s "
             "(tunnel down?); aborting\n"
         )
+        os._exit(3)
+    if err:
+        sys.stderr.write(f"bench: backend init failed: {err[0]}\n")
         os._exit(3)
 
 

@@ -1,0 +1,92 @@
+"""Tensor parallelism: training with model_parallel_size=2 must produce the
+same parameters as pure data parallelism (the sharding rules change only the
+layout, never the math)."""
+
+from argparse import Namespace
+
+import numpy as np
+
+import jax
+
+from unicore_tpu.losses import LOSS_REGISTRY
+from unicore_tpu.models.bert import BertModel
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+from unicore_tpu.trainer import Trainer
+
+
+class _Task(UnicoreTask):
+    class _D:
+        def pad(self):
+            return 1
+
+    dictionary = _D()
+
+
+def make_sample(seed):
+    r = np.random.RandomState(seed)
+    tok = r.randint(4, 64, size=(8, 32)).astype(np.int64)
+    tgt = np.where(r.rand(8, 32) < 0.2, tok, 1).astype(np.int64)
+    return {"net_input": {"src_tokens": tok}, "target": tgt}
+
+
+def run(model_par, steps=3, zero1=False, bf16=False):
+    args = Namespace(
+        seed=1, bf16=bf16, fp16=False, bf16_sr=False, allreduce_fp32_grad=False,
+        fp16_init_scale=4, fp16_scale_window=None, min_loss_scale=1e-4,
+        clip_norm=1.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=model_par,
+        seq_parallel_size=1, pipeline_parallel_size=1, expert_parallel_size=1,
+        zero_shard_optimizer=zero1, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8, weight_decay=0.01,
+        force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+        validate_with_ema=False, max_update=100, update_freq=[1],
+        donate_train_state=False, no_weight_decay_names="",
+    )
+    model = BertModel(
+        vocab_size=64, padding_idx=1, encoder_layers=2, encoder_embed_dim=32,
+        encoder_ffn_embed_dim=64, encoder_attention_heads=4, max_seq_len=32,
+        post_ln=True, dropout=0.0, emb_dropout=0.0, attention_dropout=0.0,
+    )
+    tr = Trainer(args, _Task(args), model, LOSS_REGISTRY["masked_lm"](_Task(args)))
+    tr.init_state(make_sample(0))
+    for i in range(steps):
+        tr.train_step([make_sample(i)])
+    params = jax.device_get(tr._state["params"])
+    macc = {k: float(v) for k, v in jax.device_get(tr._macc).items()}
+    return params, macc
+
+
+def test_tp2_matches_dp_only():
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8 devices")
+    p_dp, m_dp = run(model_par=1)
+    p_tp, m_tp = run(model_par=2)
+    leaves_dp = jax.tree_util.tree_leaves(p_dp)
+    leaves_tp = jax.tree_util.tree_leaves(p_tp)
+    worst = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(leaves_dp, leaves_tp)
+    )
+    # only matmul/collective reduction-order noise is allowed
+    assert worst < 5e-5, worst
+    assert abs(m_dp["loss"] - m_tp["loss"]) / max(1.0, abs(m_dp["loss"])) < 1e-5
+    assert abs(m_dp["gnorm"] - m_tp["gnorm"]) < 1e-4
+
+
+def test_zero1_matches_unsharded():
+    if len(jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8 devices")
+    p_base, m_base = run(model_par=1, zero1=False)
+    p_z1, m_z1 = run(model_par=1, zero1=True)
+    worst = max(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_base), jax.tree_util.tree_leaves(p_z1)
+        )
+    )
+    assert worst < 5e-5, worst
+    assert abs(m_base["loss"] - m_z1["loss"]) / max(1.0, abs(m_base["loss"])) < 1e-5
