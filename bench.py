@@ -50,7 +50,7 @@ def _backend_watchdog(timeout_s=180):
         )
         os._exit(3)
     if err:
-        sys.stderr.write(f"bench: backend init failed: {err[0]}\n")
+        sys.stderr.write(f"bench: backend init failed: {err[0]!r}\n")
         os._exit(3)
 
 

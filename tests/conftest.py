@@ -1,20 +1,13 @@
 """Test configuration: force an 8-device virtual CPU platform BEFORE any jax
 usage so multi-device SPMD paths are exercised without TPU hardware
-(SURVEY.md §4 item 2).
-
-Note: this environment presets ``JAX_PLATFORMS=axon`` (a real-TPU tunnel) and
-the axon plugin wins platform selection over the env var, so the override
-must go through ``jax.config`` — setting the env var alone is NOT enough.
-"""
+(SURVEY.md §4 item 2).  See unicore_tpu.platform_utils for why the env var
+alone is not enough in this environment."""
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from unicore_tpu.platform_utils import force_host_cpu
 
-jax.config.update("jax_platforms", "cpu")
+force_host_cpu(8)

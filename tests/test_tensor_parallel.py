@@ -29,9 +29,13 @@ def make_sample(seed):
     return {"net_input": {"src_tokens": tok}, "target": tgt}
 
 
-def run(model_par, steps=3, zero1=False, bf16=False):
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def run(model_par, steps=3, zero1=False):
     args = Namespace(
-        seed=1, bf16=bf16, fp16=False, bf16_sr=False, allreduce_fp32_grad=False,
+        seed=1, bf16=False, fp16=False, bf16_sr=False, allreduce_fp32_grad=False,
         fp16_init_scale=4, fp16_scale_window=None, min_loss_scale=1e-4,
         clip_norm=1.0, per_sample_clip_norm=0.0,
         data_parallel_size=-1, model_parallel_size=model_par,

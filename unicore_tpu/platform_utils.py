@@ -1,0 +1,27 @@
+"""Platform forcing helpers.
+
+The axon TPU plugin in this environment wins platform selection over the
+``JAX_PLATFORMS`` env var (and hangs when its tunnel is down), so switching
+to the virtual-CPU platform requires BOTH the XLA flag and a jax.config
+update before backend initialization.  One shared implementation — used by
+tests/conftest.py and __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+
+def force_host_cpu(n_devices: int = 8) -> None:
+    """Force the cpu platform with n virtual devices (call before any jax
+    backend use; a no-op config update failure means the backend already
+    initialized and the caller's device check will report the mismatch)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
