@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Multi-host BERT training (reference examples/bert/train_bert_test_multi_node.sh
+# — torchrun + NCCL there; here one process per TPU host joined via
+# jax.distributed).
+#
+# Launch ONE copy of this script per host.  Rendezvous is inferred from, in
+# order (unicore_tpu/distributed/utils.py):
+#   1. --distributed-init-method host0:port
+#   2. MASTER_ADDR / MASTER_PORT (+ RANK / WORLD_SIZE), torchrun-style
+#   3. SLURM_NODELIST / SLURM_PROCID / SLURM_NNODES (sbatch)
+#
+# Example (2 hosts):
+#   host0$ MASTER_ADDR=host0 MASTER_PORT=12355 WORLD_SIZE=2 RANK=0 ./train_bert_test_multi_node.sh
+#   host1$ MASTER_ADDR=host0 MASTER_PORT=12355 WORLD_SIZE=2 RANK=1 ./train_bert_test_multi_node.sh
+#
+# Each host loads its own data shard (EpochBatchIterator shards by process
+# index); the global batch is batch_size x total_devices and gradients psum
+# over ICI/DCN automatically.
+set -e
+cd "$(dirname "$0")"
+export PYTHONPATH="$(cd ../.. && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+[ -f example_data/train.idx ] || python make_example_data.py
+python -m unicore_tpu_cli.train example_data \
+  --task bert --loss masked_lm --arch bert_base \
+  --optimizer adam --adam-betas "(0.9, 0.98)" --adam-eps 1e-6 \
+  --clip-norm 1.0 --weight-decay 1e-4 \
+  --lr-scheduler polynomial_decay --lr 1e-4 --warmup-updates 100 \
+  --total-num-update 10000 --max-update 10000 \
+  --batch-size 4 --update-freq 1 --bf16 --seq-pad-multiple 128 \
+  --log-interval 50 --log-format simple \
+  --save-interval-updates 1000 --keep-interval-updates 5 \
+  --save-dir ./checkpoints --tmp-save-dir /tmp/ckpt_stage \
+  --num-workers 4 --seed 1 "$@"
