@@ -33,7 +33,8 @@ def test_ring_matches_full_attention(with_mask):
     assert err < 1e-5, err
 
 
-def test_ring_gradients_match():
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_ring_gradients_match(with_bias):
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
     mesh = make_mesh(data=1, seq=8)
@@ -41,17 +42,49 @@ def test_ring_gradients_match():
     q = jax.random.normal(jax.random.PRNGKey(0), (B, H, L, D))
     k = jax.random.normal(jax.random.PRNGKey(1), (B, H, L, D))
     v = jax.random.normal(jax.random.PRNGKey(2), (B, H, L, D))
+    bias = (
+        jax.random.normal(jax.random.PRNGKey(3), (H, L, L)) if with_bias else None
+    )
 
-    def loss_ring(q, k, v):
+    def loss_ring(q, k, v, b):
         return jnp.sum(
-            ring_self_attention(mesh, q, k, v, sm_scale=D ** -0.5) ** 2
+            ring_self_attention(mesh, q, k, v, bias=b, sm_scale=D ** -0.5) ** 2
         )
 
-    def loss_ref(q, k, v):
-        return jnp.sum(mha_reference(q, k, v, sm_scale=D ** -0.5) ** 2)
+    def loss_ref(q, k, v, b):
+        return jnp.sum(
+            mha_reference(
+                q, k, v, bias=None if b is None else b[None], sm_scale=D ** -0.5
+            ) ** 2
+        )
 
-    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for name, a, b in zip(["dq", "dk", "dv"], g1, g2):
+    argnums = (0, 1, 2, 3) if with_bias else (0, 1, 2)
+    g1 = jax.grad(loss_ring, argnums=argnums)(q, k, v, bias)
+    g2 = jax.grad(loss_ref, argnums=argnums)(q, k, v, bias)
+    for name, a, b in zip(["dq", "dk", "dv", "dbias"], g1, g2):
         err = float(jnp.abs(a - b).max())
         assert err < 1e-4, f"{name}: {err}"
+
+
+def test_ring_with_relpos_bias():
+    """Rel-pos-style (H, L, L) bias rides the ring: key columns rotate with
+    k/v and each device slices its query rows by ring position."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(data=1, seq=8)
+    B, H, L, D = 2, 4, 128, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, L, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, L, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, L, D))
+    bias = jax.random.normal(jax.random.PRNGKey(3), (H, L, L))
+    lens = np.array([100, 128])
+    mask = jnp.asarray((np.arange(L)[None, :] >= lens[:, None]).astype(np.int32))
+
+    out = ring_self_attention(
+        mesh, q, k, v, kv_padding_mask=mask, bias=bias, sm_scale=D ** -0.5
+    )
+    ref = mha_reference(
+        q, k, v, bias=bias[None], kv_padding_mask=mask, sm_scale=D ** -0.5
+    )
+    err = float(jnp.abs(out - ref).max())
+    assert err < 1e-5, err

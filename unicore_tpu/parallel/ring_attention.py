@@ -5,18 +5,19 @@ Each device holds a sequence chunk of q/k/v.  K/V chunks rotate around the
 ring via ``ppermute`` over ICI while every device accumulates its local
 queries' attention online (flash-style running max/sum), so the full L x L
 attention is computed with O(L/n) activation memory per device and
-communication fully overlapped with compute by XLA's collective scheduler.
+communication overlapped with compute by XLA's collective scheduler.
 
-Usage: under ``shard_map`` with the sequence dim sharded over ``axis_name``:
+Additive biases (e.g. relative-position) are STATIONARY: each device holds
+its own query rows of the (H, L, L) bias and slices the key columns that
+match the k/v chunk currently visiting (derived from the ring step), so the
+bias costs zero ICI traffic.
 
-    out = ring_attention(q, k, v, axis_name='seq', kv_mask=local_mask)
-
+Usage: under ``shard_map`` with the sequence dim sharded over ``axis_name``,
 or through :func:`ring_self_attention`, which wraps the shard_map given a
 mesh.  Numerically equivalent to full softmax attention (see
-tests/test_ring_attention.py).
+tests/test_ring_attention.py, incl. gradients).
 """
 
-import functools
 from typing import Optional
 
 import jax
@@ -31,6 +32,7 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     kv_mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
     sm_scale: float = 1.0,
 ) -> jnp.ndarray:
     """Online-softmax attention with a ring exchange of k/v chunks.
@@ -38,11 +40,18 @@ def ring_attention(
     Args (all per-device chunks, inside shard_map):
         q, k, v: (B, H, Lc, D) — Lc = L / ring_size
         kv_mask: (B, Lc) nonzero = masked out (this device's key chunk)
+        bias: (Hb, Lc, L) — THIS device's query rows over ALL key columns
+            (Hb in {1, H}); stationary, zero communication
         sm_scale: applied to q @ k^T
     Returns: (B, H, Lc, D) attention output for the local queries.
     """
     n = jax.lax.psum(1, axis_name)
     B, H, Lc, D = q.shape
+    my_idx = jax.lax.axis_index(axis_name)
+    if bias is not None:
+        assert bias.ndim == 3 and bias.shape[1] == Lc and bias.shape[2] == n * Lc, (
+            f"bias chunk must be (H|1, {Lc}, {n * Lc}), got {bias.shape}"
+        )
 
     m0 = jnp.full((B, H, Lc, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Lc, 1), jnp.float32)
@@ -55,10 +64,17 @@ def ring_attention(
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def accumulate(k_blk, v_blk, mask_blk, m, l, acc):
+    def accumulate(k_blk, v_blk, mask_blk, step_t, m, l, acc):
         s = jnp.einsum(
             "bhqd,bhkd->bhqk", q.astype(jnp.float32), k_blk.astype(jnp.float32)
         ) * sm_scale
+        if bias is not None:
+            # after t rotations this device holds the chunk that STARTED at
+            # ring position (my_idx - t) mod n, i.e. key columns
+            # [(my_idx - t) mod n * Lc, ...): slice the stationary bias there
+            src = jnp.mod(my_idx - step_t, n)
+            cols = jax.lax.dynamic_slice_in_dim(bias, src * Lc, Lc, axis=2)
+            s = s + cols[None].astype(jnp.float32)
         masked = mask_blk[:, None, None, :] != 0
         s = jnp.where(masked, NEG_INF, s)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -72,9 +88,9 @@ def ring_attention(
         )
         return m_new, l_new, acc_new
 
-    def step(carry, _):
+    def step(carry, t):
         k_blk, v_blk, mask_blk, m, l, acc = carry
-        m, l, acc = accumulate(k_blk, v_blk, mask_blk, m, l, acc)
+        m, l, acc = accumulate(k_blk, v_blk, mask_blk, t, m, l, acc)
         # rotate k/v/mask to the next device; XLA overlaps this with compute
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -84,9 +100,10 @@ def ring_attention(
     # n-1 rotated steps + a final accumulate with no rotation (the result of
     # an n-th ppermute would never be consumed — pure wasted ICI bandwidth)
     (k_l, v_l, mask_l, m, l, acc), _ = jax.lax.scan(
-        step, (k, v, kv_mask, m0, l0, acc0), None, length=n - 1
+        step, (k, v, kv_mask, m0, l0, acc0),
+        jnp.arange(n - 1, dtype=jnp.int32),
     )
-    m, l, acc = accumulate(k_l, v_l, mask_l, m, l, acc)
+    m, l, acc = accumulate(k_l, v_l, mask_l, jnp.int32(n - 1), m, l, acc)
     inv_l = jnp.where(l > 0, 1.0 / l, 0.0)
     return (acc * inv_l).astype(q.dtype)
 
@@ -97,31 +114,47 @@ def ring_self_attention(
     k: jnp.ndarray,
     v: jnp.ndarray,
     kv_padding_mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
     sm_scale: float = 1.0,
     seq_axis: str = "seq",
 ):
     """Full-array entry point: shards the sequence dim over ``seq_axis`` and
-    runs :func:`ring_attention` under shard_map."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    runs :func:`ring_attention` under shard_map.
 
+    ``bias``: additive (H|1, L, L) bias (e.g. relative-position); sharded by
+    QUERY rows (stationary per device, no communication).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    L = q.shape[2]
     qkv_spec = P(None, None, seq_axis, None)
     mask_spec = P(None, seq_axis)
     out_spec = qkv_spec
 
     if kv_padding_mask is None:
-        kv_padding_mask = jnp.zeros(
-            (q.shape[0], q.shape[2]), jnp.int32
-        )
+        kv_padding_mask = jnp.zeros((q.shape[0], L), jnp.int32)
 
-    def local_fn(q_, k_, v_, mask_):
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, mask_spec]
+    operands = [q, k, v, kv_padding_mask]
+    if bias is not None:
+        if bias.ndim == 2:
+            bias = bias[None]
+        assert bias.shape[-2:] == (L, L), (
+            f"bias must be (H|1, {L}, {L}), got {bias.shape}"
+        )
+        in_specs.append(P(None, seq_axis, None))  # query rows sharded
+        operands.append(bias)
+
+    def local_fn(q_, k_, v_, mask_, *rest):
         return ring_attention(
-            q_, k_, v_, axis_name=seq_axis, kv_mask=mask_, sm_scale=sm_scale
+            q_, k_, v_, axis_name=seq_axis, kv_mask=mask_,
+            bias=rest[0] if rest else None, sm_scale=sm_scale,
         )
 
     fn = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        in_specs=tuple(in_specs),
         out_specs=out_spec,
     )
-    return fn(q, k, v, kv_padding_mask)
+    return fn(*operands)
