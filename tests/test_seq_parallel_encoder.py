@@ -1,0 +1,94 @@
+"""End-to-end sequence parallelism: a Transformer encoder with
+use_ring=True on a seq=8 mesh must match the dense encoder exactly
+(rel-pos bias + padding mask included)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.modules import TransformerEncoder
+from unicore_tpu.parallel import make_mesh, set_global_mesh
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh():
+    yield
+    set_global_mesh(None)
+
+
+def test_ring_encoder_matches_dense():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(data=1, seq=8)
+    set_global_mesh(mesh)
+
+    B, L, E, H = 2, 128, 64, 4
+    enc_ring = TransformerEncoder(
+        encoder_layers=2, embed_dim=E, ffn_embed_dim=128, attention_heads=H,
+        max_seq_len=L, use_ring=True, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0,
+    )
+    enc_dense = TransformerEncoder(
+        encoder_layers=2, embed_dim=E, ffn_embed_dim=128, attention_heads=H,
+        max_seq_len=L, use_ring=False, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0,
+    )
+
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    pm = jnp.asarray(
+        (np.arange(L)[None, :] >= np.array([100, 128])[:, None]).astype(np.float32)
+    )
+    params = enc_ring.init({"params": jax.random.PRNGKey(1)}, emb)
+    o_ring = enc_ring.apply(params, emb, padding_mask=pm)
+    o_dense = enc_dense.apply(params, emb, padding_mask=pm)
+    err = float(jnp.abs(o_ring - o_dense).max())
+    assert err < 1e-4, err
+
+    # gradients flow through the ring path (incl. rel-pos bias params)
+    g_ring = jax.grad(
+        lambda p: jnp.sum(enc_ring.apply(p, emb, padding_mask=pm) ** 2)
+    )(params)
+    g_dense = jax.grad(
+        lambda p: jnp.sum(enc_dense.apply(p, emb, padding_mask=pm) ** 2)
+    )(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_ring), jax.tree_util.tree_leaves(g_dense)
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-4
+
+
+def test_ring_falls_back_without_seq_mesh():
+    """No seq axis in the mesh (or no mesh): use_ring silently uses the
+    regular paths — same output."""
+    set_global_mesh(None)
+    B, L, E, H = 1, 64, 32, 4
+    enc = TransformerEncoder(
+        encoder_layers=1, embed_dim=E, ffn_embed_dim=64, attention_heads=H,
+        max_seq_len=L, use_ring=True, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0,
+    )
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    params = enc.init({"params": jax.random.PRNGKey(1)}, emb)
+    out = enc.apply(params, emb)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_ring_with_data_parallel_mesh():
+    """data=2 x seq=4: batch rides the data axis, ring rides seq."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.parallel import ring_self_attention
+    from unicore_tpu.ops.flash_attention import mha_reference
+
+    mesh = make_mesh(data=2, seq=4)
+    B, H, L, D = 4, 2, 64, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, L, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, L, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, L, D))
+    bias = jax.random.normal(jax.random.PRNGKey(3), (H, L, L))
+    out = ring_self_attention(mesh, q, k, v, bias=bias, sm_scale=D ** -0.5)
+    ref = mha_reference(q, k, v, bias=bias[None], sm_scale=D ** -0.5)
+    assert float(jnp.abs(out - ref).max()) < 1e-5

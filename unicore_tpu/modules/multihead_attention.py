@@ -13,6 +13,7 @@ einsum -> MXU).  Two execution paths behind the same API:
   reference kernel's semantics.
 """
 
+import logging
 from typing import Optional
 
 import flax.linen as nn
@@ -97,6 +98,46 @@ def _flash_ok(tgt_len, src_len, head_dim, dtype):
     )
 
 
+def _ring_ok(use_ring, return_attn, eff_dropout, tgt_len, src_len, attn_bias,
+             bsz, num_heads):
+    """Gate for the sequence-parallel ring path: needs a live mesh with a
+    seq axis, dropout off (no in-ring dropout yet), self-attention shapes,
+    and a batch-independent bias.  Returns (mesh, bias_chunk) or None."""
+    if not use_ring or return_attn or tgt_len != src_len:
+        return None
+    if eff_dropout > 0.0:
+        # falling back here during training would quietly lose ring's
+        # memory savings at exactly the long L that motivated it — say so
+        global _warned_ring_dropout
+        if not _warned_ring_dropout:
+            logging.getLogger(__name__).warning(
+                "use_ring requested but attention dropout > 0: ring "
+                "attention has no in-ring dropout yet, using the dense "
+                "path for training steps (set attention_dropout=0 to keep "
+                "the ring active in training)"
+            )
+            _warned_ring_dropout = True
+        return None
+    from unicore_tpu.parallel import SEQ_AXIS, get_global_mesh
+
+    mesh = get_global_mesh()
+    if mesh is None or SEQ_AXIS not in mesh.shape:
+        return None
+    ring = mesh.shape[SEQ_AXIS]
+    if ring <= 1 or tgt_len % ring != 0:
+        return None
+    bias_chunk = None
+    if attn_bias is not None:
+        b = _bias_min_broadcast(attn_bias, bsz, num_heads, tgt_len, src_len)
+        if b is None or b.shape[0] != 1:
+            return None  # per-batch biases not supported on the ring yet
+        bias_chunk = b[0]  # (H|1, L, L)
+    return mesh, bias_chunk
+
+
+_warned_ring_dropout = False
+
+
 def _attend(
     module,
     q, k, v,
@@ -106,8 +147,9 @@ def _attend(
     train,
     return_attn,
     use_flash,
+    use_ring=False,
 ):
-    """Shared core: pick flash vs fused-softmax path."""
+    """Shared core: pick ring (seq-parallel) vs flash vs fused-softmax."""
     bsz, num_heads, tgt_len, head_dim = q.shape
     src_len = k.shape[2]
 
@@ -115,6 +157,22 @@ def _attend(
         key_padding_mask = None
 
     eff_dropout = dropout_rate if train else 0.0
+
+    ring = _ring_ok(
+        use_ring, return_attn, eff_dropout, tgt_len, src_len, attn_bias,
+        bsz, num_heads,
+    )
+    if ring is not None:
+        from unicore_tpu.parallel.ring_attention import ring_self_attention
+
+        ring_mesh, bias_r = ring
+        o = ring_self_attention(
+            ring_mesh, q, k, v,
+            kv_padding_mask=key_padding_mask,
+            bias=bias_r,
+            sm_scale=1.0,  # q is pre-scaled
+        )
+        return o, None, None
 
     dropout_backend_ok = (
         eff_dropout == 0.0 or jax.default_backend() in ("tpu", "axon")
@@ -181,6 +239,7 @@ class SelfMultiheadAttention(nn.Module):
     bias: bool = True
     scaling_factor: float = 1.0
     use_flash: bool = True
+    use_ring: bool = False  # seq-parallel ring attention over the mesh 'seq' axis
 
     @nn.compact
     def __call__(
@@ -213,6 +272,7 @@ class SelfMultiheadAttention(nn.Module):
         o, attn_weights, attn_probs = _attend(
             self, q, k, v, key_padding_mask, attn_bias,
             self.dropout, train, return_attn, self.use_flash,
+            use_ring=self.use_ring,
         )
 
         o = _merge_heads(o)

@@ -87,6 +87,7 @@ class TransformerEncoderLayer(nn.Module):
     activation_dropout: float = 0.0
     activation_fn: str = "gelu"
     post_ln: bool = False
+    use_ring: bool = False
 
     @nn.compact
     def __call__(
@@ -113,6 +114,7 @@ class TransformerEncoderLayer(nn.Module):
             self.embed_dim,
             self.attention_heads,
             dropout=self.attention_dropout,
+            use_ring=self.use_ring,
             name="self_attn",
         )(
             x,
@@ -178,6 +180,7 @@ class TransformerEncoder(nn.Module):
     post_ln: bool = False
     remat: bool = False  # activation checkpointing per layer
                          # (reference utils.checkpoint_sequential, utils.py:306-333)
+    use_ring: bool = False  # seq-parallel ring attention (mesh 'seq' axis)
 
     def setup(self):
         self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
@@ -200,6 +203,7 @@ class TransformerEncoder(nn.Module):
                 activation_dropout=self.activation_dropout,
                 activation_fn=self.activation_fn,
                 post_ln=self.post_ln,
+                use_ring=self.use_ring,
                 name=f"layers_{i}",
             )
             for i in range(self.encoder_layers)

@@ -53,12 +53,13 @@ def ring_attention(
             f"bias chunk must be (H|1, {Lc}, {n * Lc}), got {bias.shape}"
         )
 
-    m0 = jnp.full((B, H, Lc, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Lc, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, Lc, D), jnp.float32)
-    # mark the initial accumulators as device-varying so the scan carry type
-    # matches the (sharded-input-derived) outputs
-    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), (axis_name,), to="varying")
+    # derive the accumulators from q so they inherit its device-varying axes
+    # (whatever mesh axes the enclosing shard_map shards over) — the scan
+    # carry types must match the sharded-input-derived outputs
+    zero_like_q = q.astype(jnp.float32) * 0.0
+    m0 = zero_like_q[..., :1] + NEG_INF
+    l0 = zero_like_q[..., :1]
+    acc0 = zero_like_q
     if kv_mask is None:
         kv_mask = jnp.zeros((B, k.shape[2]), jnp.int32)
 
@@ -126,9 +127,14 @@ def ring_self_attention(
     """
     from jax.sharding import PartitionSpec as P
 
+    from .mesh import DATA_AXIS
+
     L = q.shape[2]
-    qkv_spec = P(None, None, seq_axis, None)
-    mask_spec = P(None, seq_axis)
+    # batch rides the data axis (when the mesh has one) so data-parallel
+    # groups keep their own shards instead of all-gathering the batch
+    batch_axis = DATA_AXIS if DATA_AXIS in mesh.shape else None
+    qkv_spec = P(batch_axis, None, seq_axis, None)
+    mask_spec = P(batch_axis, seq_axis)
     out_spec = qkv_spec
 
     if kv_padding_mask is None:
