@@ -88,3 +88,33 @@ def test_ring_with_relpos_bias():
     )
     err = float(jnp.abs(out - ref).max())
     assert err < 1e-5, err
+
+
+def test_ring_dropout_deterministic_and_mass_preserving():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(data=1, seq=8)
+    B, H, L, D = 2, 4, 128, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, L, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, L, D))
+    v = jnp.ones((B, H, L, D))
+    rng = jax.random.PRNGKey(7)
+    o1 = ring_self_attention(mesh, q, k, v, dropout_rate=0.4,
+                             dropout_rng=rng, sm_scale=D ** -0.5)
+    o2 = ring_self_attention(mesh, q, k, v, dropout_rate=0.4,
+                             dropout_rng=rng, sm_scale=D ** -0.5)
+    o3 = ring_self_attention(mesh, q, k, v, dropout_rate=0.4,
+                             dropout_rng=jax.random.PRNGKey(8),
+                             sm_scale=D ** -0.5)
+    assert bool(jnp.all(o1 == o2))
+    assert bool(jnp.any(o1 != o3))
+    # v == ones: expected output is ~1 (inverted dropout preserves mass)
+    assert abs(float(jnp.mean(o1)) - 1.0) < 0.05
+    # grads flow
+    g = jax.grad(
+        lambda q_: jnp.sum(
+            ring_self_attention(mesh, q_, k, v, dropout_rate=0.4,
+                                dropout_rng=rng, sm_scale=D ** -0.5) ** 2
+        )
+    )(q)
+    assert bool(jnp.isfinite(g).all())

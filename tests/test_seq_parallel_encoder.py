@@ -92,3 +92,34 @@ def test_ring_with_data_parallel_mesh():
     out = ring_self_attention(mesh, q, k, v, bias=bias, sm_scale=D ** -0.5)
     ref = mha_reference(q, k, v, bias=bias[None], sm_scale=D ** -0.5)
     assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+def test_ring_encoder_training_with_dropout():
+    """attention_dropout > 0 now runs ON the ring (in-ring dropout)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    set_global_mesh(make_mesh(data=1, seq=8))
+    B, L, E, H = 2, 128, 64, 4
+    enc = TransformerEncoder(
+        encoder_layers=1, embed_dim=E, ffn_embed_dim=128, attention_heads=H,
+        max_seq_len=L, use_ring=True, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.3,
+    )
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    params = enc.init(
+        {"params": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)}, emb
+    )
+    o1 = enc.apply(params, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)})
+    o2 = enc.apply(params, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)})
+    o3 = enc.apply(params, emb, train=True, rngs={"dropout": jax.random.PRNGKey(4)})
+    assert bool(jnp.all(o1 == o2))       # deterministic per rng
+    assert bool(jnp.any(o1 != o3))       # varies across rngs
+    assert bool(jnp.isfinite(o1).all())
+    g = jax.grad(
+        lambda p: jnp.sum(
+            enc.apply(p, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)}) ** 2
+        )
+    )(params)
+    assert all(
+        bool(jnp.isfinite(x).all()) for x in jax.tree_util.tree_leaves(g)
+    )

@@ -13,7 +13,6 @@ einsum -> MXU).  Two execution paths behind the same API:
   reference kernel's semantics.
 """
 
-import logging
 from typing import Optional
 
 import flax.linen as nn
@@ -98,25 +97,12 @@ def _flash_ok(tgt_len, src_len, head_dim, dtype):
     )
 
 
-def _ring_ok(use_ring, return_attn, eff_dropout, tgt_len, src_len, attn_bias,
+def _ring_ok(use_ring, return_attn, tgt_len, src_len, attn_bias,
              bsz, num_heads):
     """Gate for the sequence-parallel ring path: needs a live mesh with a
-    seq axis, dropout off (no in-ring dropout yet), self-attention shapes,
-    and a batch-independent bias.  Returns (mesh, bias_chunk) or None."""
+    seq axis, self-attention shapes, and a batch-independent bias (dropout
+    is handled in-ring).  Returns (mesh, bias_chunk) or None."""
     if not use_ring or return_attn or tgt_len != src_len:
-        return None
-    if eff_dropout > 0.0:
-        # falling back here during training would quietly lose ring's
-        # memory savings at exactly the long L that motivated it — say so
-        global _warned_ring_dropout
-        if not _warned_ring_dropout:
-            logging.getLogger(__name__).warning(
-                "use_ring requested but attention dropout > 0: ring "
-                "attention has no in-ring dropout yet, using the dense "
-                "path for training steps (set attention_dropout=0 to keep "
-                "the ring active in training)"
-            )
-            _warned_ring_dropout = True
         return None
     from unicore_tpu.parallel import SEQ_AXIS, get_global_mesh
 
@@ -133,9 +119,6 @@ def _ring_ok(use_ring, return_attn, eff_dropout, tgt_len, src_len, attn_bias,
             return None  # per-batch biases not supported on the ring yet
         bias_chunk = b[0]  # (H|1, L, L)
     return mesh, bias_chunk
-
-
-_warned_ring_dropout = False
 
 
 def _attend(
@@ -159,18 +142,20 @@ def _attend(
     eff_dropout = dropout_rate if train else 0.0
 
     ring = _ring_ok(
-        use_ring, return_attn, eff_dropout, tgt_len, src_len, attn_bias,
-        bsz, num_heads,
+        use_ring, return_attn, tgt_len, src_len, attn_bias, bsz, num_heads,
     )
     if ring is not None:
         from unicore_tpu.parallel.ring_attention import ring_self_attention
 
         ring_mesh, bias_r = ring
+        rng = module.make_rng("dropout") if eff_dropout > 0.0 else None
         o = ring_self_attention(
             ring_mesh, q, k, v,
             kv_padding_mask=key_padding_mask,
             bias=bias_r,
             sm_scale=1.0,  # q is pre-scaled
+            dropout_rate=eff_dropout,
+            dropout_rng=rng,
         )
         return o, None, None
 

@@ -34,6 +34,9 @@ def ring_attention(
     kv_mask: Optional[jnp.ndarray] = None,
     bias: Optional[jnp.ndarray] = None,
     sm_scale: float = 1.0,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    extra_rng_axes: tuple = (),
 ) -> jnp.ndarray:
     """Online-softmax attention with a ring exchange of k/v chunks.
 
@@ -43,11 +46,24 @@ def ring_attention(
         bias: (Hb, Lc, L) — THIS device's query rows over ALL key columns
             (Hb in {1, H}); stationary, zero communication
         sm_scale: applied to q @ k^T
+        dropout_rate/dropout_rng: attention dropout on the probabilities;
+            the key is folded per (device, ring step) so every block gets a
+            decorrelated stream (normalization uses pre-dropout mass, same
+            semantics as ops.softmax_dropout)
     Returns: (B, H, Lc, D) attention output for the local queries.
     """
     n = jax.lax.psum(1, axis_name)
     B, H, Lc, D = q.shape
     my_idx = jax.lax.axis_index(axis_name)
+    if dropout_rate > 0.0:
+        assert dropout_rng is not None, "dropout needs dropout_rng"
+        dropout_rng = jax.random.fold_in(dropout_rng, my_idx)
+        # decorrelate across every other sharded mesh axis too (data shards
+        # would otherwise reuse identical masks for their batch slices)
+        for ax in extra_rng_axes:
+            dropout_rng = jax.random.fold_in(
+                dropout_rng, jax.lax.axis_index(ax)
+            )
     if bias is not None:
         assert bias.ndim == 3 and bias.shape[1] == Lc and bias.shape[2] == n * Lc, (
             f"bias chunk must be (H|1, {Lc}, {n * Lc}), got {bias.shape}"
@@ -84,8 +100,13 @@ def ring_attention(
         p = jnp.where(masked, 0.0, p)
         corr = jnp.exp(m - m_new)
         l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        p_use = p
+        if dropout_rate > 0.0:
+            key = jax.random.fold_in(dropout_rng, step_t)
+            keep = jax.random.bernoulli(key, 1.0 - dropout_rate, p.shape)
+            p_use = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_new = corr * acc + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+            "bhqk,bhkd->bhqd", p_use, v_blk.astype(jnp.float32)
         )
         return m_new, l_new, acc_new
 
@@ -117,6 +138,8 @@ def ring_self_attention(
     kv_padding_mask: Optional[jnp.ndarray] = None,
     bias: Optional[jnp.ndarray] = None,
     sm_scale: float = 1.0,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jnp.ndarray] = None,
     seq_axis: str = "seq",
 ):
     """Full-array entry point: shards the sequence dim over ``seq_axis`` and
@@ -142,7 +165,8 @@ def ring_self_attention(
 
     in_specs = [qkv_spec, qkv_spec, qkv_spec, mask_spec]
     operands = [q, k, v, kv_padding_mask]
-    if bias is not None:
+    has_bias = bias is not None
+    if has_bias:
         if bias.ndim == 2:
             bias = bias[None]
         assert bias.shape[-2:] == (L, L), (
@@ -150,11 +174,20 @@ def ring_self_attention(
         )
         in_specs.append(P(None, seq_axis, None))  # query rows sharded
         operands.append(bias)
+    if dropout_rate > 0.0:
+        assert dropout_rng is not None
+        in_specs.append(P())  # replicated base key; folded per device inside
+        operands.append(dropout_rng)
 
     def local_fn(q_, k_, v_, mask_, *rest):
+        rest = list(rest)
+        bias_ = rest.pop(0) if has_bias else None
+        rng_ = rest.pop(0) if dropout_rate > 0.0 else None
         return ring_attention(
             q_, k_, v_, axis_name=seq_axis, kv_mask=mask_,
-            bias=rest[0] if rest else None, sm_scale=sm_scale,
+            bias=bias_, sm_scale=sm_scale,
+            dropout_rate=dropout_rate, dropout_rng=rng_,
+            extra_rng_axes=(batch_axis,) if batch_axis else (),
         )
 
     fn = jax.shard_map(
