@@ -169,3 +169,21 @@ def test_evoformer_msa_e2e(tmp_path):
     ]
     out = run_cli(argv)
     assert "num_updates: 3" in out
+
+
+def test_orbax_checkpoint_format_e2e(data_dir, tmp_path):
+    args = common_args(data_dir, str(tmp_path), 6) + [
+        "--checkpoint-format", "orbax", "--save-interval-updates", "4",
+        "--keep-interval-updates", "1",
+    ]
+    out = run_cli(args)
+    assert "num_updates: 6" in out
+    ckpt = tmp_path / "ckpt" / "checkpoint_last.pt"
+    assert ckpt.is_dir()  # orbax checkpoints are directories
+    assert (ckpt / "meta.pk").exists()
+    # resume through the CLI
+    out2 = run_cli(common_args(data_dir, str(tmp_path), 10) + [
+        "--checkpoint-format", "orbax",
+    ])
+    assert "Loaded checkpoint" in out2
+    assert "num_updates: 10" in out2

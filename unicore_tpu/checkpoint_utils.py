@@ -32,6 +32,14 @@ logger = logging.getLogger(__name__)
 # async copy + retention (reference ckp_copy_fun, checkpoint_utils.py:23-80)
 # ---------------------------------------------------------------------------
 
+def _remove_checkpoint(path):
+    if os.path.lexists(path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            os.remove(path)
+        logger.info(f"removed {path}")
+
 def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
     has_copy = False
     can_delete = args.tmp_save_dir != args.save_dir
@@ -40,14 +48,28 @@ def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
             if src != cp:
                 logger.info(f"copy {src} to {cp}")
                 has_copy = True
-                shutil.copyfile(src, cp)
+                if os.path.isdir(src):  # orbax checkpoints are directories
+                    # near-atomic replace: stage the copy, then swap —
+                    # preemption mid-copy never destroys the old checkpoint
+                    tmp = cp + ".tmp"
+                    if os.path.lexists(tmp):
+                        shutil.rmtree(tmp, ignore_errors=True)
+                    shutil.copytree(src, tmp)
+                    if os.path.lexists(cp):
+                        shutil.rmtree(cp, ignore_errors=True)
+                    os.rename(tmp, cp)
+                else:
+                    shutil.copyfile(src, cp)
         except Exception:
             logger.info("copy failed, please copy it manually")
 
     try:
         if can_delete and has_copy and os.path.lexists(src):
             logger.info(f"removing temp file {src} ...")
-            os.remove(src)
+            if os.path.isdir(src):
+                shutil.rmtree(src, ignore_errors=True)
+            else:
+                os.remove(src)
 
         def remove_ckps(root_path):
             if not end_of_epoch and args.keep_interval_updates > 0:
@@ -56,16 +78,12 @@ def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
                     root_path, pattern=r"checkpoint_\d+_(\d+)\.pt"
                 )
                 for old_chk in ckps[args.keep_interval_updates:]:
-                    if os.path.lexists(old_chk):
-                        os.remove(old_chk)
-                        logger.info(f"removed {old_chk}")
+                    _remove_checkpoint(old_chk)
 
             if args.keep_last_epochs >= 0:
                 ckps = checkpoint_paths(root_path, pattern=r"checkpoint(\d+)\.pt")
                 for old_chk in ckps[args.keep_last_epochs:]:
-                    if os.path.lexists(old_chk):
-                        os.remove(old_chk)
-                        logger.info(f"removed {old_chk}")
+                    _remove_checkpoint(old_chk)
 
             if args.keep_best_checkpoints > 0:
                 ckps = checkpoint_paths(
@@ -77,9 +95,7 @@ def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
                 if not args.maximize_best_checkpoint_metric:
                     ckps = ckps[::-1]
                 for old_chk in ckps[args.keep_best_checkpoints:]:
-                    if os.path.lexists(old_chk):
-                        os.remove(old_chk)
-                        logger.info(f"removed {old_chk}")
+                    _remove_checkpoint(old_chk)
 
         remove_ckps(args.save_dir)
     except Exception:
@@ -109,7 +125,11 @@ def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
     if args.no_save or not do_save:
         return
 
-    if not trainer.should_save_checkpoint_on_current_rank:
+    collective = getattr(args, "checkpoint_format", "pickle") == "orbax"
+    if not collective and not trainer.should_save_checkpoint_on_current_rank:
+        # pickle saves are rank-0-only; orbax saves are COLLECTIVE — every
+        # process must reach trainer.save_checkpoint or the sharded write
+        # deadlocks at orbax's multihost barrier
         return
 
     write_timer = meters.StopwatchMeter()
@@ -162,6 +182,8 @@ def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
     ]
     if len(checkpoints) > 0:
         trainer.save_checkpoint(tmp_checkpoints[0], extra_state)
+        if not trainer.should_save_checkpoint_on_current_rank:
+            return  # non-zero ranks only participate in the collective write
         if ckp_copy_thread is not None:
             ckp_copy_thread.apply_async(
                 ckp_copy_fun, (tmp_checkpoints[0], checkpoints, end_of_epoch, args)

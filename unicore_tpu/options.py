@@ -373,6 +373,11 @@ def add_checkpoint_args(parser):
                        help="suffix to add to the checkpoint file name")
     group.add_argument("--async-checkpoint", type=utils.str_to_bool, default=True,
                        help="write checkpoints on a background thread")
+    group.add_argument("--checkpoint-format", default="pickle",
+                       choices=["pickle", "orbax"],
+                       help="pickle: single-file numpy pytree (rank-0 write); "
+                            "orbax: per-host SHARDED tensorstore checkpoint "
+                            "(no rank-0 gather bottleneck, shardings preserved)")
     return group
 
 

@@ -31,6 +31,7 @@ per update:
 
 import contextlib
 import logging
+import os
 import sys
 import time
 from argparse import Namespace
@@ -833,6 +834,99 @@ class Trainer(object):
     # checkpointing (reference trainer.py:258-482)
     # ------------------------------------------------------------------
 
+    def _use_orbax(self):
+        return getattr(self.args, "checkpoint_format", "pickle") == "orbax"
+
+    def _orbax_ckptr(self):
+        if getattr(self, "_ockptr", None) is None:
+            import orbax.checkpoint as ocp
+
+            self._ockptr = ocp.StandardCheckpointer()
+        return self._ockptr
+
+    def _orbax_state_to_save(self):
+        """State subtree to persist (honors --no-save-optimizer-state)."""
+        if getattr(self.args, "no_save_optimizer_state", False):
+            return {k: v for k, v in self._state.items() if k != "opt"}
+        return self._state
+
+    def _orbax_save(self, filename, extra_state):
+        """Per-host SHARDED save: EVERY process participates in the
+        collective orbax write of its own shards (params/opt/ema/scalars) —
+        no rank-0 gather (SURVEY.md §5.4 'per-host sharded save replaces
+        the rank-0 bottleneck'); rank 0 alone prepares the directory and
+        writes the host metadata pickle."""
+        import shutil as _sh
+
+        path = os.path.abspath(filename)
+        if self.is_data_parallel_master and os.path.lexists(path):
+            _sh.rmtree(path, ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("orbax_pre_save")
+        ckptr = self._orbax_ckptr()
+        ckptr.save(path, self._orbax_state_to_save())
+        ckptr.wait_until_finished()
+        if not self.is_data_parallel_master:
+            return
+        meta = {
+            "args": self.args,
+            "optimizer_history": [
+                {
+                    "optimizer_name": self._optimizer.__class__.__name__,
+                    "lr_scheduler_state": self._lr_scheduler.state_dict(),
+                    "num_updates": self.get_num_updates(),
+                }
+            ],
+            "task_state": self.task.state_dict(),
+            "extra_state": {
+                "metrics": metrics.state_dict(),
+                "previous_training_time": self.cumulative_training_time(),
+                **extra_state,
+            },
+        }
+        checkpoint_utils.persistent_save(meta, os.path.join(path, "meta.pk"))
+
+    def _orbax_restore(self, path, reset_optimizer):
+        path = os.path.abspath(path)
+        ckptr = self._orbax_ckptr()
+        if not reset_optimizer:
+            try:
+                restored = ckptr.restore(path, self._orbax_state_to_save())
+                # params-only checkpoints leave the current opt state in place
+                self._state = {**self._state, **restored}
+                return
+            except Exception as e:
+                logger.warning(
+                    f"structured orbax restore failed ({e}); falling back "
+                    "to params-only merge"
+                )
+        # reset_optimizer / structure mismatch (different optimizer, EMA
+        # config, or params-only checkpoint): templateless read, then merge
+        # params (+ema) into the current state with its shardings
+        raw = ckptr.restore(path)
+        shardings = self._state_shardings(self._state)
+        merged = checkpoint_utils.merge_params(
+            checkpoint_utils.to_numpy_tree(self._state["params"]),
+            checkpoint_utils.to_numpy_tree(raw["params"]),
+            strict=True,
+        )
+        params = jax.tree_util.tree_map(
+            lambda t, p: jnp.asarray(t).astype(p.dtype),
+            merged, self._state["params"],
+        )
+        self._state["params"] = jax.device_put(params, shardings["params"])
+        if "ema" in raw and "ema" in self._state:
+            self._state["ema"] = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, raw["ema"]),
+                shardings["ema"],
+            )
+        if self._state["opt"]["master"] is not None:
+            self._state["opt"]["master"] = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), self._state["params"]
+            )
+
     def state_dict(self):
         save_opt = self._state is not None and not getattr(
             self.args, "no_save_optimizer_state", False
@@ -867,10 +961,13 @@ class Trainer(object):
 
     def save_checkpoint(self, filename, extra_state):
         logger.info(f"Saving checkpoint to {filename}")
-        state_dict = self.state_dict()
-        state_dict["extra_state"].update(extra_state)
-        if self.should_save_checkpoint_on_current_rank:
-            checkpoint_utils.persistent_save(state_dict, filename)
+        if self._use_orbax() and self._state is not None:
+            self._orbax_save(filename, extra_state)
+        else:
+            state_dict = self.state_dict()
+            state_dict["extra_state"].update(extra_state)
+            if self.should_save_checkpoint_on_current_rank:
+                checkpoint_utils.persistent_save(state_dict, filename)
         logger.info(f"Finished saving checkpoint to {filename}")
 
     def load_checkpoint(
@@ -885,28 +982,37 @@ class Trainer(object):
         """Load from file; restores model, optimizer, scheduler, meters,
         iterator position (reference trainer.py:299-482)."""
         extra_state, last_optim_state = None, None
-        import os
-
         bexists = os.path.exists(filename)
         if bexists:
             logger.info(f"Preparing to load checkpoint {filename}")
-            state = checkpoint_utils.load_checkpoint_to_cpu(
-                filename, load_on_all_ranks=True
-            )
+            is_orbax = os.path.isdir(filename)
+            if is_orbax:
+                state = checkpoint_utils.load_checkpoint_to_cpu(
+                    os.path.join(filename, "meta.pk"), load_on_all_ranks=True
+                )
+            else:
+                state = checkpoint_utils.load_checkpoint_to_cpu(
+                    filename, load_on_all_ranks=True
+                )
             extra_state = state.get("extra_state", None)
             last_optim_state = state.get("optimizer_state", None)
 
             # model params: need a state; if missing, defer until first batch
             if self._state is None:
-                self._pending_checkpoint_state = (
-                    state,
-                    reset_optimizer,
-                    optimizer_overrides,
-                )
+                if is_orbax:
+                    self._pending_orbax = (filename, reset_optimizer)
+                else:
+                    self._pending_checkpoint_state = (
+                        state,
+                        reset_optimizer,
+                        optimizer_overrides,
+                    )
                 logger.info(
                     "deferring checkpoint param load until state init "
                     "(will merge on first batch)"
                 )
+            elif is_orbax:
+                self._orbax_restore(filename, reset_optimizer)
             else:
                 self._merge_checkpoint(state, reset_optimizer)
                 if not reset_optimizer:
@@ -997,6 +1103,12 @@ class Trainer(object):
     def maybe_apply_pending_checkpoint(self):
         """Apply a checkpoint that arrived before state init, honoring the
         reset flags captured at load time."""
+        pending_orbax = getattr(self, "_pending_orbax", None)
+        if pending_orbax is not None and self._state is not None:
+            path, reset_optimizer = pending_orbax
+            self._orbax_restore(path, reset_optimizer)
+            self._pending_orbax = None
+            return
         pending = getattr(self, "_pending_checkpoint_state", None)
         if pending is not None and self._state is not None:
             state, reset_optimizer, optimizer_overrides = pending
