@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Capture a jax.profiler trace of the bench training step and print a
+per-op time breakdown (top HLO ops by self time), using the xplane proto
+from tensorboard_plugin_profile.  Builder-side tool; not part of the
+shipped package."""
+
+import glob
+import os
+import sys
+import time
+from argparse import Namespace
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    batch_size, seq_len, vocab = 64, 512, 30522
+    args = Namespace(
+        seed=1, bf16=True, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=1.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=1,
+        pipeline_parallel_size=1, expert_parallel_size=1,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-4], adam_betas="(0.9, 0.98)", adam_eps=1e-6, weight_decay=1e-4,
+        force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+        validate_with_ema=False, max_update=10_000, update_freq=[1],
+    )
+
+    class _BenchTask(UnicoreTask):
+        class _Dict:
+            def pad(self):
+                return 1
+
+        dictionary = _Dict()
+
+    task = _BenchTask(args)
+    rng = np.random.RandomState(0)
+    model = BertModel(
+        vocab_size=vocab, padding_idx=1, encoder_layers=12,
+        encoder_embed_dim=768, encoder_ffn_embed_dim=3072,
+        encoder_attention_heads=12, max_seq_len=seq_len, post_ln=True,
+    )
+    loss = LOSS_REGISTRY["masked_lm"](task)
+    tokens = rng.randint(4, vocab, size=(batch_size, seq_len)).astype(np.int64)
+    target = np.where(rng.rand(batch_size, seq_len) < 0.15, tokens, 1).astype(np.int64)
+    sample = {"net_input": {"src_tokens": tokens}, "target": target}
+
+    trainer = Trainer(args, task, model, loss)
+    trainer.init_state(sample)
+    sample = trainer._prepare_sample(sample)
+
+    def force():
+        leaf = jax.tree_util.tree_leaves(trainer.state["params"])[0]
+        return float(jnp.sum(leaf.astype(jnp.float32)))
+
+    for _ in range(3):
+        trainer.train_step([sample])
+    force()
+
+    logdir = "/tmp/jaxprof"
+    os.system(f"rm -rf {logdir}")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(logdir):
+        for _ in range(3):
+            trainer.train_step([sample])
+        force()
+    dt = time.perf_counter() - t0
+    print(f"3 steps traced in {dt:.3f}s ({dt/3*1000:.1f} ms/step)")
+
+    # ---- parse xplane ----
+    paths = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    if not paths:
+        print("no xplane found", glob.glob(f"{logdir}/**", recursive=True))
+        return
+    from tensorboard_plugin_profile.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(paths[0], "rb") as f:
+        xs.ParseFromString(f.read())
+
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "tpu" not in plane.name.lower():
+            continue
+        ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
+        op_time = defaultdict(int)
+        total = 0
+        for line in plane.lines:
+            lname = line.name
+            if "XLA Ops" not in lname and "xla op" not in lname.lower():
+                continue
+            for ev in line.events:
+                name = ev_meta.get(ev.metadata_id, "?")
+                op_time[name] += ev.duration_ps
+                total += ev.duration_ps
+        if not op_time:
+            # fallback: dump line names
+            print(f"plane {plane.name}: lines = {[l.name for l in plane.lines]}")
+            continue
+        print(f"\n=== plane: {plane.name}  (total op time {total/1e12*1000:.1f} ms over 3 steps) ===")
+        # group by fusion-op prefix
+        grouped = defaultdict(int)
+        for name, t in op_time.items():
+            key = name.split(".")[0]
+            grouped[key] += t
+        for name, t in sorted(grouped.items(), key=lambda kv: -kv[1])[:40]:
+            print(f"{t/1e12*1000/3:9.3f} ms/step  {100*t/total:5.1f}%  {name}")
+
+
+if __name__ == "__main__":
+    main()

@@ -175,6 +175,24 @@ def _attend(
                     module.make_rng("dropout"), (), 0, 2 ** 31 - 1,
                     dtype=jnp.int32,
                 )
+            # moderate rows: one-shot softmax + single-pass fused backward
+            from unicore_tpu.ops.attention_fullrow import (
+                fullrow_attention, supported as _fullrow_supported,
+            )
+
+            if _fullrow_supported(
+                tgt_len, src_len, head_dim,
+                None if bias_min is None else bias_min.shape[0],
+            ):
+                o = fullrow_attention(
+                    q, k, v,
+                    bias=bias_min,
+                    kv_padding_mask=key_padding_mask,
+                    dropout_rate=eff_dropout,
+                    dropout_seed=seed,
+                    sm_scale=1.0,  # q is pre-scaled
+                )
+                return o, None, None
             o = flash_attention(
                 q, k, v,
                 bias=bias_min,
