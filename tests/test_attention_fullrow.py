@@ -45,8 +45,8 @@ def test_group_picking():
     assert fr._pick_group(6, 8) == 6
     assert fr._pick_group(7, 4) == 1
     # f32 at L=512 must shrink below the bf16 group
-    g_bf16 = fr._auto_group(64, 512, 512, 64, 2, 8, 8, True)
-    g_f32 = fr._auto_group(64, 512, 512, 64, 4, 8, 8, True)
+    g_bf16 = fr._auto_group(64, 512, 512, 64, 2, 8, 8, 3)
+    g_f32 = fr._auto_group(64, 512, 512, 64, 4, 8, 8, 3)
     assert g_f32 <= g_bf16
 
 

@@ -53,11 +53,12 @@ def _pick_group(batch, preferred):
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16 MB/core VMEM
 
 
-def _auto_group(B, Lq, Lk, D, itemsize, preferred, n_streams, has_bias):
+def _auto_group(B, Lq, Lk, D, itemsize, preferred, n_streams, bias_bufs):
     """Shrink the batch group until the kernel's VMEM footprint fits:
-    ``n_streams`` double-buffered (G, L, D) blocks + the (Lq, Lk) bias
-    block/scratch + fp32 score/probability temporaries."""
-    fixed = (2 if has_bias else 0) * Lq * Lk * 4 + 4 * Lq * Lk * 4
+    ``n_streams`` double-buffered (G, L, D) blocks + ``bias_bufs``
+    (Lq, Lk) fp32 bias buffers (fwd: the bias block; bwd: bias block +
+    db scratch + db output block) + fp32 score/probability temporaries."""
+    fixed = (bias_bufs + 4) * Lq * Lk * 4
     per_g = 2 * n_streams * max(Lq, Lk) * D * itemsize
     g = _pick_group(B, preferred)
     while g > 1 and fixed + g * per_g > _VMEM_BUDGET:
@@ -156,7 +157,7 @@ def _fwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, group):
     Lk = k.shape[2]
     has_bias = bias is not None
     has_mask = kv_mask is not None
-    G = _auto_group(B, Lq, Lk, D, q.dtype.itemsize, group, 4, has_bias)
+    G = _auto_group(B, Lq, Lk, D, q.dtype.itemsize, group, 4, 1 if has_bias else 0)
 
     qspec, _, in_specs = _io_specs(B, H, Lq, Lk, D, G, bias, kv_mask)
     inputs = [q, k, v]
@@ -285,7 +286,7 @@ def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, group, do):
     Lk = k.shape[2]
     has_bias = bias is not None
     has_mask = kv_mask is not None
-    G = _auto_group(B, Lq, Lk, D, q.dtype.itemsize, group, 8, has_bias)
+    G = _auto_group(B, Lq, Lk, D, q.dtype.itemsize, group, 8, 3 if has_bias else 0)
     nbg = B // G
     Hb = bias.shape[1] if has_bias else 1
     bias_per_head = Hb > 1
