@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Line-similarity sweep vs the reference (same method as the round-1
+verdict): difflib ratio over line lists for same-named / same-relative-path
+file pairs.  Run from the repo root; prints files above the threshold."""
+
+import difflib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+THRESHOLD = float(sys.argv[1]) if len(sys.argv) > 1 else 0.5
+
+
+def lines(path):
+    try:
+        with open(path, errors="replace") as f:
+            return f.read().splitlines()
+    except OSError:
+        return None
+
+
+def ref_candidates(rel):
+    """Map our path to plausible reference counterparts."""
+    out = []
+    parts = rel.split(os.sep)
+    if parts[0] == "unicore_tpu":
+        out.append(os.path.join(REF, "unicore", *parts[1:]))
+    if parts[0] == "unicore_tpu_cli":
+        out.append(os.path.join(REF, "unicore_cli", *parts[1:]))
+    out.append(os.path.join(REF, rel))
+    # same basename anywhere in the reference tree
+    base = os.path.basename(rel)
+    for dirpath, _, files in os.walk(REF):
+        if base in files:
+            out.append(os.path.join(dirpath, base))
+    return out
+
+
+def main():
+    rows = []
+    for dirpath, dirnames, files in os.walk(REPO):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in (".git", "__pycache__", "node_modules", ".pytest_cache")
+        ]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, REPO)
+            if rel.startswith("tests") or rel.startswith("scripts"):
+                continue
+            mine = lines(path)
+            if not mine or len(mine) < 20:
+                continue
+            best, best_ref = 0.0, None
+            for cand in set(ref_candidates(rel)):
+                theirs = lines(cand)
+                if not theirs:
+                    continue
+                r = difflib.SequenceMatcher(None, mine, theirs).ratio()
+                if r > best:
+                    best, best_ref = r, os.path.relpath(cand, REF)
+            rows.append((best, rel, best_ref, len(mine)))
+    rows.sort(reverse=True)
+    flagged = 0
+    for ratio, rel, ref_rel, n in rows:
+        if ratio >= THRESHOLD:
+            flagged += 1
+            print(f"{ratio:.2f}  {rel}  <->  {ref_rel}  ({n} L)")
+    print(f"\n{flagged} file(s) >= {THRESHOLD}")
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,71 @@ def test_overflow_skips_update_and_shrinks_scale():
     assert macc["overflow"] == 1.0
 
 
+def test_scale_tolerance_defers_shrink():
+    """--fp16-scale-tolerance: a rare overflow (pct < tolerance) must NOT
+    shrink the scale; repeated overflows must (reference
+    dynamic_loss_scaler.py:43-71)."""
+    from unicore_tpu.optim.dynamic_loss_scaler import (
+        init_scale_state,
+        scale_schedule,
+    )
+
+    kw = dict(scale_window=1000, min_loss_scale=1e-4, tolerance=0.5)
+    st = init_scale_state(128.0)
+    # 9 clean steps, then 1 overflow: pct = 1/10 < 0.5 -> scale holds
+    for _ in range(9):
+        st, pinned = scale_schedule(st, jnp.asarray(False), **kw)
+        assert not bool(pinned)
+    st, pinned = scale_schedule(st, jnp.asarray(True), **kw)
+    assert float(st["scale"]) == 128.0 and not bool(pinned)
+    # overflowing most steps pushes pct over 0.5 -> shrink happens
+    for _ in range(12):
+        st, pinned = scale_schedule(st, jnp.asarray(True), **kw)
+    assert float(st["scale"]) < 128.0
+
+
+def test_host_scaler_tolerance_and_min_scale():
+    from unicore_tpu.optim.dynamic_loss_scaler import DynamicLossScaler
+
+    s = DynamicLossScaler(
+        init_scale=64.0, scale_window=1000, tolerance=0.6, min_loss_scale=1.0
+    )
+    for _ in range(3):
+        s.update()
+    try:
+        s.check_overflow(float("inf"))
+    except OverflowError:
+        pass
+    # 1 overflow in 4 steps: 25% < 60% tolerance -> no shrink
+    assert s.loss_scale == 64.0
+    # shrink repeatedly; at min_loss_scale the scaler aborts
+    aborted = False
+    for _ in range(100):
+        try:
+            s.check_overflow(float("nan"))
+        except OverflowError:
+            continue
+        except FloatingPointError:
+            aborted = True
+            break
+    assert aborted, "min-scale abort never fired"
+    assert s.loss_scale > s.min_loss_scale / 2
+
+
+def test_min_scale_abort_at_flush():
+    """Scale pinned at min_loss_scale while overflowing -> the trainer
+    raises FloatingPointError at its next metrics flush (reference aborts
+    training, dynamic_loss_scaler.py:70-80)."""
+    import pytest
+
+    tr = make_trainer(init_scale=2.0 ** 120)
+    tr.args.min_loss_scale = 2.0 ** 119  # first shrink already pins
+    tr.init_state(make_sample())
+    tr.train_step([make_sample()])  # overflows at this scale
+    with pytest.raises(FloatingPointError, match="Minimum loss scale"):
+        tr.flush_metrics()
+
+
 def test_normal_fp16_training_grows_scale():
     tr = make_trainer(init_scale=4.0)
     tr.init_state(make_sample())

@@ -38,7 +38,9 @@ def run(force_seq):
     tr = Trainer(args, T(args), model, LOSS_REGISTRY["masked_lm"](T(args)))
     tr.init_state(mk(1))
     if force_seq:
-        tr._try_stack_microbatches = lambda samples: None  # force micro-step path
+        tr._try_stack_microbatches = (
+            lambda samples, modes=None: None  # force micro-step path
+        )
     tr.train_step([mk(1), mk(2)])
     leaf = jax.tree_util.tree_leaves(tr._state["params"])[0]
     macc = {k: float(v) for k, v in jax.device_get(tr._macc).items()}

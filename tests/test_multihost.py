@@ -35,7 +35,7 @@ print(f"RANK{rank}_OK", flush=True)
 """
 
 
-def test_two_process_cluster_collectives(tmp_path):
+def _run_two_procs(worker_src, timeout=300):
     import socket
 
     with socket.socket() as s:  # grab a free port for the coordinator
@@ -43,7 +43,8 @@ def test_two_process_cluster_collectives(tmp_path):
         port = str(s.getsockname()[1])
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER.replace("__REPO__", REPO), str(r), "2", port],
+            [sys.executable, "-c", worker_src.replace("__REPO__", REPO),
+             str(r), "2", port],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -53,10 +54,144 @@ def test_two_process_cluster_collectives(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
         outs.append(out)
     for r, out in enumerate(outs):
-        assert f"RANK{r}_OK" in out, f"rank {r} failed:\n{out[-3000:]}"
+        assert f"RANK{r}_OK" in out, f"rank {r} failed:\n{out[-5000:]}"
+    return outs
+
+
+def test_two_process_cluster_collectives(tmp_path):
+    _run_two_procs(WORKER)
+
+
+TRAIN_WORKER = r"""
+import os, sys
+rank = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n, process_id=rank)
+sys.path.insert(0, "__REPO__")
+import hashlib
+import numpy as np
+import jax.numpy as jnp
+from unicore_tpu.distributed import utils as du
+
+assert jax.device_count() == 2 * n  # 4-device global mesh, 2 per host
+
+# --- host collectives new surface: all_to_all + broadcast_tensors ---------
+a2a = du.all_to_all(np.arange(4).reshape(4, 1) + 10 * rank)
+# host r keeps row-block r of every host's array
+exp = np.concatenate([np.arange(2 * rank, 2 * rank + 2).reshape(2, 1) + 10 * s
+                      for s in range(n)], axis=0)
+assert (a2a == exp).all(), (a2a, exp)
+bt = du.broadcast_tensors(
+    [np.ones((3,)) * 7, np.arange(6).reshape(2, 3)] if rank == 0 else None)
+assert (bt[0] == 7).all() and bt[1].shape == (2, 3)
+
+# --- build a trainer over the 4-device (dp=4) global mesh -----------------
+sys.path.insert(0, "__REPO__")
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "graft_entry", "__REPO__/__graft_entry__.py")
+ge = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ge)
+from argparse import Namespace
+from unicore_tpu.losses import LOSS_REGISTRY
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+from unicore_tpu.trainer import Trainer
+
+args = Namespace(
+    seed=1, bf16=False, fp16=False, bf16_sr=False, allreduce_fp32_grad=False,
+    fp16_init_scale=4, fp16_scale_window=None, min_loss_scale=1e-4,
+    clip_norm=1.0, per_sample_clip_norm=0.0,
+    data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=1,
+    pipeline_parallel_size=1, expert_parallel_size=1,
+    zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+    lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8, weight_decay=0.0,
+    force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+    validate_with_ema=False, max_update=10, update_freq=[1],
+)
+
+class _T(UnicoreTask):
+    class _D:
+        def pad(self):
+            return 0
+    dictionary = _D()
+
+task = _T(args)
+model = ge._flagship(vocab=128, layers=1, dim=64, heads=2, ffn=128, max_seq=16)
+loss = LOSS_REGISTRY["masked_lm"](task)
+trainer = Trainer(args, task, model, loss)
+
+def make_batch(seed, rows):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(3, 128, size=(rows, 16)).astype(np.int64)
+    target = np.where(rng.rand(rows, 16) < 0.15, tokens, 0).astype(np.int64)
+    return {"net_input": {"src_tokens": tokens}, "target": target}
+
+# per-host DIFFERENT 4-row batches; global batch must be 8 rows
+mine = make_batch(100 + rank, 4)
+both = [make_batch(100 + r, 4) for r in range(n)]
+global_sample_size = float(sum((b["target"] != 0).sum() for b in both))
+
+trainer.train_step([mine])
+m = {k: float(v) for k, v in jax.device_get(trainer._macc).items()}
+# sample_size proves BOTH hosts' rows entered the global batch: a host-local
+# feed would count only this host's masked tokens
+assert abs(m["sample_size"] - global_sample_size) < 0.5, (
+    m["sample_size"], global_sample_size)
+
+# --- params must be bit-identical across hosts after the step -------------
+def param_hash(t):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(t)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+h0 = param_hash(trainer._state["params"])
+hashes = du.all_gather_list(h0)
+assert hashes[0] == hashes[1], "params diverged across hosts"
+
+# --- epoch-tail path: divergent row counts -> gather mode (replicated) ----
+tail = make_batch(200 + rank, 3 + rank)  # 3 rows on host0, 4 on host1
+tail_all = [make_batch(200 + r, 3 + r) for r in range(n)]
+tail_ss = float(sum((b["target"] != 0).sum() for b in tail_all))
+trainer._macc = None
+trainer.train_step([tail])
+m = {k: float(v) for k, v in jax.device_get(trainer._macc).items()}
+assert abs(m["sample_size"] - tail_ss) < 0.5, (m["sample_size"], tail_ss)
+hashes = du.all_gather_list(param_hash(trainer._state["params"]))
+assert hashes[0] == hashes[1], "params diverged after gather-mode step"
+
+# --- one host exhausted (empty), the other real: still a global step ------
+lone = make_batch(300, 4) if rank == 1 else {}
+lone_ss = float((make_batch(300, 4)["target"] != 0).sum())
+trainer._macc = None
+trainer.train_step([lone])
+m = {k: float(v) for k, v in jax.device_get(trainer._macc).items()}
+assert abs(m["sample_size"] - lone_ss) < 0.5, (m["sample_size"], lone_ss)
+
+# --- fused grad-accum scan works multi-host (one program for uf=2) --------
+trainer._macc = None
+trainer.train_step([make_batch(400 + rank, 4), make_batch(500 + rank, 4)])
+assert "scan_step" in trainer._jit_cache, "multi-host uf>1 did not fuse"
+m = {k: float(v) for k, v in jax.device_get(trainer._macc).items()}
+assert np.isfinite(m["gnorm"]), m
+hashes = du.all_gather_list(param_hash(trainer._state["params"]))
+assert hashes[0] == hashes[1], "params diverged after scan step"
+
+print(f"RANK{rank}_OK", flush=True)
+"""
+
+
+def test_two_process_train_step(tmp_path):
+    """ADVICE r1 (high): global batches must be assembled from process-local
+    data — per-host rows all enter the step, and params stay bit-identical
+    across hosts, in shard, gather (tail), dummy-peer, and fused-scan modes."""
+    _run_two_procs(TRAIN_WORKER, timeout=420)

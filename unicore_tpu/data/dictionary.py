@@ -1,10 +1,13 @@
-"""Symbol dictionary (reference /root/reference/unicore/data/dictionary.py:12).
+"""Symbol table mapping token strings to consecutive integer ids.
 
-BERT-style special tokens ([CLS]/[PAD]/[SEP]/[UNK]) with text-file round-trip.
+Parity surface (reference /root/reference/unicore/data/dictionary.py:12):
+BERT-style special tokens ([CLS]/[PAD]/[SEP]/[UNK]), out-of-vocabulary
+lookups resolving to unk, and the ``<symbol> <count>`` text-file round-trip
+(including the ``#overwrite`` flag).  Implementation original to this
+framework.
 """
 
 import logging
-from typing import List
 
 import numpy as np
 
@@ -12,149 +15,144 @@ logger = logging.getLogger(__name__)
 
 
 class Dictionary:
-    """A mapping from symbols to consecutive integers."""
+    """Symbols are assigned ids in insertion order; lookups of unknown
+    symbols return the unk id once unk has been registered."""
 
     def __init__(
         self,
-        *,  # begin keyword-only arguments
+        *,
         bos="[CLS]",
         pad="[PAD]",
         eos="[SEP]",
         unk="[UNK]",
         extra_special_symbols=None,
     ):
-        self.bos_word, self.unk_word, self.pad_word, self.eos_word = bos, unk, pad, eos
+        self.bos_word = bos
+        self.pad_word = pad
+        self.eos_word = eos
+        self.unk_word = unk
         self.symbols = []
         self.count = []
         self.indices = {}
-        self.specials = set()
-        self.specials.add(bos)
-        self.specials.add(unk)
-        self.specials.add(pad)
-        self.specials.add(eos)
+        self.specials = {bos, pad, eos, unk}
 
-    def __eq__(self, other):
-        return self.indices == other.indices
-
-    def __getitem__(self, idx):
-        if idx < len(self.symbols):
-            return self.symbols[idx]
-        return self.unk_word
+    # -- container protocol -------------------------------------------------
 
     def __len__(self):
-        """Returns the number of symbols in the dictionary"""
         return len(self.symbols)
 
     def __contains__(self, sym):
         return sym in self.indices
 
-    def vec_index(self, a):
-        getter = np.vectorize(lambda sym: self.index(sym))
-        return getter(a)
+    def __getitem__(self, idx):
+        """Id -> symbol; out-of-range ids render as the unk symbol."""
+        return self.symbols[idx] if 0 <= idx < len(self.symbols) else self.unk_word
+
+    def __eq__(self, other):
+        return self.indices == other.indices
+
+    # -- lookups ------------------------------------------------------------
 
     def index(self, sym):
-        """Returns the index of the specified symbol"""
+        """Symbol -> id, falling back to unk for unregistered symbols."""
         assert isinstance(sym, str)
-        if sym in self.indices:
-            return self.indices[sym]
+        idx = self.indices.get(sym)
+        if idx is not None:
+            return idx
         if self.unk_word not in self.indices:
             raise KeyError(
-                f"'{sym}' not in dictionary and unk symbol '{self.unk_word}' "
-                "is missing too"
+                f"'{sym}' not in dictionary and unk symbol "
+                f"'{self.unk_word}' is missing too"
             )
         return self.unk()
 
-    def special_index(self):
-        return [self.index(x) for x in self.specials]
+    def vec_index(self, a):
+        """Elementwise symbol -> id over an array of strings."""
+        return np.vectorize(self.index)(a)
 
-    def add_symbol(self, word, n=1, overwrite=False, is_special=False):
-        """Adds a word to the dictionary"""
-        if is_special:
-            self.specials.add(word)
-        if word in self.indices and not overwrite:
-            idx = self.indices[word]
-            self.count[idx] = self.count[idx] + n
-            return idx
-        else:
-            idx = len(self.symbols)
-            self.indices[word] = idx
-            self.symbols.append(word)
-            self.count.append(n)
-            return idx
+    def special_index(self):
+        return [self.index(s) for s in self.specials]
 
     def bos(self):
-        """Helper to get index of beginning-of-sentence symbol"""
         return self.index(self.bos_word)
 
     def pad(self):
-        """Helper to get index of pad symbol"""
         return self.index(self.pad_word)
 
     def eos(self):
-        """Helper to get index of end-of-sentence symbol"""
         return self.index(self.eos_word)
 
     def unk(self):
-        """Helper to get index of unk symbol"""
         return self.index(self.unk_word)
+
+    # -- construction -------------------------------------------------------
+
+    def add_symbol(self, word, n=1, overwrite=False, is_special=False):
+        """Register a symbol (or bump its count if already present and not
+        overwriting); returns its id."""
+        if is_special:
+            self.specials.add(word)
+        existing = self.indices.get(word)
+        if existing is not None and not overwrite:
+            self.count[existing] += n
+            return existing
+        idx = len(self.symbols)
+        self.indices[word] = idx
+        self.symbols.append(word)
+        self.count.append(n)
+        return idx
+
+    # -- text-file round-trip ----------------------------------------------
 
     @classmethod
     def load(cls, f):
-        """Load the dictionary from a text file with the format:
-
-        ```
-        <symbol0> <count0>
-        <symbol1> <count1>
-        ...
-        ```
-        """
+        """Build a dictionary from a ``<symbol> <count>``-per-line file."""
         d = cls()
         d.add_from_file(f)
         return d
 
     def add_from_file(self, f):
-        """Load a pre-existing dictionary from a text file."""
+        """Merge symbols from a text file (path or open handle).
+
+        Each line is ``<symbol> [<count>] [#overwrite]``; a missing count
+        defaults to the line's distance from the end (preserving relative
+        order as frequency).
+        """
         if isinstance(f, str):
             try:
                 with open(f, "r", encoding="utf-8") as fd:
                     self.add_from_file(fd)
-            except FileNotFoundError as fnfe:
-                raise fnfe
             except UnicodeError:
                 raise Exception(f"Incorrect encoding detected in {f}")
             return
 
         lines = f.readlines()
-
-        for line_idx, line in enumerate(lines):
+        for line_no, raw in enumerate(lines):
+            word, _, field = raw.rstrip().rpartition(" ")
+            if not word:
+                word, field = field, str(len(lines) - line_no)
+            overwrite = field == "#overwrite"
+            if overwrite:
+                word, _, field = word.rpartition(" ")
             try:
-                splits = line.rstrip().rsplit(" ", 1)
-                line = splits[0]
-                field = splits[1] if len(splits) > 1 else str(len(lines) - line_idx)
-                if field == "#overwrite":
-                    overwrite = True
-                    line, field = line.rsplit(" ", 1)
-                else:
-                    overwrite = False
-                count = int(field)
-                word = line
-                if word in self and not overwrite:
-                    logger.info(
-                        "Duplicate word found when loading Dictionary: '{}', index is {}.".format(
-                            word, self.indices[word]
-                        )
-                    )
-                else:
-                    self.add_symbol(word, n=count, overwrite=overwrite)
+                n = int(field)
             except ValueError:
                 raise ValueError(
-                    "Incorrect dictionary format, expected '<token> <cnt> [flags]'"
+                    "Incorrect dictionary format, expected "
+                    "'<token> <cnt> [flags]'"
                 )
+            if word in self and not overwrite:
+                logger.info(
+                    f"Duplicate word found when loading Dictionary: "
+                    f"'{word}', index is {self.indices[word]}."
+                )
+            else:
+                self.add_symbol(word, n=n, overwrite=overwrite)
 
     def save(self, f):
-        """Store dictionary into a text file."""
+        """Write ``<symbol> <count>`` lines (path or open handle)."""
         if isinstance(f, str):
             with open(f, "w", encoding="utf-8") as fd:
                 return self.save(fd)
-        for symbol, count in zip(self.symbols, self.count):
-            print(f"{symbol} {count}", file=f)
+        for symbol, n in zip(self.symbols, self.count):
+            print(f"{symbol} {n}", file=f)

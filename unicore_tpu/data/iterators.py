@@ -1,26 +1,26 @@
-"""Resumable, sharded batch iterators
-(reference /root/reference/unicore/data/iterators.py).
+"""Resumable, sharded batch iterators.
 
-Differences from the reference, by design:
+Parity surface (reference /root/reference/unicore/data/iterators.py): the
+``EpochBatchIterator`` contract — multi-epoch iteration with per-epoch
+shuffle, per-host shards padded to equal length, mid-epoch ``state_dict``
+resume with proportional position rescaling when the iterator length
+changed, grad-accumulation grouping, and background prefetch with a
+bottleneck warning.  Implementation original to this framework:
+
 - No torch DataLoader: batches are fetched + collated by a thread pool
   (numpy releases the GIL for the heavy copies) and double-buffered by
-  :class:`BufferedIterator`, which overlaps host collation with device step
-  time the way the reference's worker processes + pinned-memory buffer do.
-- Per-host sharding: ``num_shards`` = number of *hosts* (JAX processes); the
-  per-device split happens later via ``jax.device_put`` with a mesh sharding,
-  so there is no per-device iterator to desync (the reference's dummy-batch
-  protocol is unnecessary).
-- Same resume contract: ``state_dict`` captures (epoch, iterations_in_epoch,
-  shuffle, len) and ``load_state_dict`` fast-forwards, proportionally
-  rescaling the position when the iterator length changed
-  (reference iterators.py:326-350).
+  :class:`BufferedIterator`, overlapping host collation with device step
+  time the way the reference's worker processes + pinned buffers do.
+- ``num_shards`` = number of *hosts* (JAX processes); the per-device split
+  happens later via the trainer's global-batch assembly, so there is no
+  per-device iterator to desync.
+- Epoch planning (shuffle + shard) is one pure function; the iterator
+  classes are pull-based (``__next__``) rather than generator-wrapped.
 """
 
 import itertools
 import logging
 import math
-import operator
-import os
 import queue
 import threading
 import time
@@ -32,64 +32,62 @@ from . import data_utils
 
 logger = logging.getLogger(__name__)
 
-# Object used by _background_consumer to signal the source is exhausted
-# to the main thread.
-_sentinel = object()
+# queue sentinel: the producer thread finished cleanly
+_DONE = object()
 
 
 class CountingIterator(object):
-    """Iterator wrapper that tracks the number of elements consumed
-    (reference iterators.py:28-102)."""
+    """Pull-based wrapper that tracks how many items were consumed.
+
+    ``n`` counts consumed items (resuming iterators start it at their
+    offset); ``total`` bounds the expected length.  Pulling past ``total``
+    while the source still produces raises, because it means the resume
+    arithmetic and the actual stream disagree.
+    """
 
     def __init__(self, iterable, start=None, total=None):
         self.iterable = iterable
-        self.itr = iter(self)
-
-        if start is None:
-            self.n = getattr(iterable, "n", 0)
-        else:
-            self.n = start
-
-        if total is None:
-            self.total = self.n + len(iterable)
-        else:
-            self.total = total
+        self._itr = iter(iterable)
+        self.n = getattr(iterable, "n", 0) if start is None else start
+        self.total = self.n + len(iterable) if total is None else total
 
     def __len__(self):
         return self.total
 
     def __iter__(self):
-        for x in self.iterable:
-            if self.n >= self.total:
-                raise RuntimeError(
-                    "Mismatch between actual and expected iterable length. "
-                    "This may be caused by resuming training from a checkpoint using "
-                    "a different number of workers or update_freq."
-                )
-            self.n += 1
-            yield x
+        return self
 
     def __next__(self):
-        return next(self.itr)
+        x = next(self._itr)  # StopIteration ends the epoch
+        if self.n >= self.total:
+            raise RuntimeError(
+                "Mismatch between actual and expected iterable length. "
+                "This may be caused by resuming training from a checkpoint "
+                "using a different number of workers or update_freq."
+            )
+        self.n += 1
+        return x
 
     def has_next(self):
-        return self.n < len(self)
+        return self.n < self.total
 
     def skip(self, num_to_skip):
-        """Fast-forward the iterator by skipping *num_to_skip* elements."""
-        next(itertools.islice(self.itr, num_to_skip, num_to_skip), None)
+        """Consume and discard ``num_to_skip`` items."""
+        for _ in itertools.islice(self, num_to_skip):
+            pass
         return self
 
     def take(self, n):
-        """Truncates the iterator to n elements at most."""
+        """Cap the iterator at ``n`` items, propagating to the source."""
         self.total = min(self.total, n)
-        # Propagate this change to the underlying iterator
         if hasattr(self.iterable, "take"):
             self.iterable.take(n)
         return self
 
 
 class EpochBatchIterating(object):
+    """Protocol for epoch-based iterators (resume + epoch bookkeeping)."""
+
     def __len__(self) -> int:
         raise NotImplementedError
 
@@ -122,8 +120,9 @@ class EpochBatchIterating(object):
 class EpochBatchIterator(EpochBatchIterating):
     """Multi-epoch iterator over a dataset with host-sharding and resume.
 
-    Args mirror the reference (iterators.py:167-230) minus torch-specific
-    knobs; ``num_shards``/``shard_id`` are the JAX process count/index.
+    Constructor args mirror the reference (iterators.py:167-230) minus
+    torch-specific knobs; ``num_shards``/``shard_id`` are the JAX process
+    count/index.
     """
 
     def __init__(
@@ -144,19 +143,18 @@ class EpochBatchIterator(EpochBatchIterating):
         self.collate_fn = collate_fn
         self.batch_sampler = batch_sampler
         self._frozen_batches = (
-            tuple(batch_sampler) if not callable(batch_sampler) else None
+            None if callable(batch_sampler) else tuple(batch_sampler)
         )
         self.seed = seed
         self.num_shards = num_shards
         self.shard_id = shard_id
         self.num_workers = num_workers
-        # This upper limit here is to prevent people from abusing this feature
-        # in a shared computing environment.
+        # capped: an oversized prefetch buffer just hoards host RAM
         self.buffer_size = min(buffer_size, 20)
         self.timeout = timeout
         self.disable_shuffling = disable_shuffling
 
-        self.epoch = max(epoch, 1)  # we use 1-based indexing for epochs
+        self.epoch = max(epoch, 1)  # epochs are 1-based
         self.shuffle = not disable_shuffling
         self._cur_epoch_itr = None
         self._next_epoch_itr = None
@@ -165,7 +163,9 @@ class EpochBatchIterator(EpochBatchIterating):
     @property
     def frozen_batches(self):
         if self._frozen_batches is None:
-            self._frozen_batches = tuple(self.batch_sampler(self.dataset, self.epoch))
+            self._frozen_batches = tuple(
+                self.batch_sampler(self.dataset, self.epoch)
+            )
         return self._frozen_batches
 
     @property
@@ -178,9 +178,10 @@ class EpochBatchIterator(EpochBatchIterating):
                 "a larger dataset."
             )
         if getattr(self.dataset, "supports_fetch_outside_dataloader", True):
-            return self.collate_fn([self.dataset[i] for i in self.frozen_batches[0]])
-        else:
-            return "DUMMY"
+            return self.collate_fn(
+                [self.dataset[i] for i in self.frozen_batches[0]]
+            )
+        return "DUMMY"
 
     def __len__(self):
         return int(math.ceil(len(self.frozen_batches) / float(self.num_shards)))
@@ -191,29 +192,26 @@ class EpochBatchIterator(EpochBatchIterating):
 
     @property
     def next_epoch_idx(self):
-        """Return the epoch index after *next_epoch_itr* is called."""
+        """The epoch the next ``next_epoch_itr`` call will serve."""
         if self._next_epoch_itr is not None:
-            return self.epoch
-        elif self._cur_epoch_itr is not None and self.end_of_epoch():
+            return self.epoch  # a resumed mid-epoch iterator is pending
+        if self._cur_epoch_itr is not None and self.end_of_epoch():
             return self.epoch + 1
-        else:
-            return self.epoch
+        return self.epoch
 
     def next_epoch_itr(self, shuffle=True, fix_batches_to_gpus=False,
                        set_dataset_epoch=True):
-        """Return a new iterator over the dataset for the next epoch."""
         if self.disable_shuffling:
             shuffle = False
         self.epoch = self.next_epoch_idx
         if set_dataset_epoch and hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(self.epoch)
         if self._next_epoch_itr is not None:
-            self._cur_epoch_itr = self._next_epoch_itr
-            self._next_epoch_itr = None
+            # hand over the iterator prepared by load_state_dict
+            self._cur_epoch_itr, self._next_epoch_itr = self._next_epoch_itr, None
         else:
             if callable(self.batch_sampler):
-                # reset _frozen_batches to refresh the next epoch
-                self._frozen_batches = None
+                self._frozen_batches = None  # re-plan batches for this epoch
             self._cur_epoch_itr = self._get_iterator_for_epoch(
                 self.epoch, shuffle, fix_batches_to_gpus=fix_batches_to_gpus
             )
@@ -221,99 +219,105 @@ class EpochBatchIterator(EpochBatchIterating):
         return self._cur_epoch_itr
 
     def end_of_epoch(self) -> bool:
-        """Returns whether the most recent epoch iterator has been exhausted"""
         return not self._cur_epoch_itr.has_next()
 
     @property
     def iterations_in_epoch(self):
-        """The number of consumed batches in the current epoch."""
-        if self._cur_epoch_itr is not None:
-            return self._cur_epoch_itr.n
-        elif self._next_epoch_itr is not None:
-            return self._next_epoch_itr.n
+        for itr in (self._cur_epoch_itr, self._next_epoch_itr):
+            if itr is not None:
+                return itr.n
         return 0
 
     def state_dict(self):
+        """Position snapshot; an exhausted epoch serializes as the start of
+        the next one."""
         if self.end_of_epoch():
-            epoch = self.epoch + 1
-            iter_in_epoch = 0
-        else:
-            epoch = self.epoch
-            iter_in_epoch = self.iterations_in_epoch
+            return {
+                "epoch": self.epoch + 1,
+                "iterations_in_epoch": 0,
+                "shuffle": self.shuffle,
+                "len": len(self),
+            }
         return {
-            "epoch": epoch,
-            "iterations_in_epoch": iter_in_epoch,
+            "epoch": self.epoch,
+            "iterations_in_epoch": self.iterations_in_epoch,
             "shuffle": self.shuffle,
             "len": len(self),
         }
 
     def load_state_dict(self, state_dict):
         self.epoch = state_dict["epoch"]
-        itr_pos = state_dict.get("iterations_in_epoch", 0)
-        if itr_pos > 0:
-            if "len" in state_dict and state_dict["len"] != len(self):
-                # proportional rescale when world size / update_freq changed
-                old_itr_pos = itr_pos
-                itr_pos = int(itr_pos * len(self) / state_dict["len"])
-                logger.info(
-                    "Iterator size changed (update_freq / host count?); "
-                    f"rescaling itr_pos {old_itr_pos} -> {itr_pos} for consistency"
-                )
-            # fast-forward epoch iterator
-            self._next_epoch_itr = self._get_iterator_for_epoch(
-                self.epoch,
-                shuffle=state_dict.get("shuffle", True),
-                offset=itr_pos,
-            )
-            if self._next_epoch_itr is None:
-                raise RuntimeError(
-                    "Cannot resume training due to dataloader mismatch. You can "
-                    "relaunch training with `--reset-dataloader` and it should work."
-                )
-        else:
+        offset = state_dict.get("iterations_in_epoch", 0)
+        if offset == 0:
             self._next_epoch_itr = None
+            return
+        saved_len = state_dict.get("len")
+        if saved_len is not None and saved_len != len(self):
+            # host count or update_freq changed since the checkpoint: keep
+            # the same fraction of the epoch consumed
+            rescaled = int(offset * len(self) / saved_len)
+            logger.info(
+                "Iterator size changed (update_freq / host count?); "
+                f"rescaling itr_pos {offset} -> {rescaled} for consistency"
+            )
+            offset = rescaled
+        self._next_epoch_itr = self._get_iterator_for_epoch(
+            self.epoch,
+            shuffle=state_dict.get("shuffle", True),
+            offset=offset,
+        )
+        if self._next_epoch_itr is None:
+            raise RuntimeError(
+                "Cannot resume training due to dataloader mismatch. You can "
+                "relaunch training with `--reset-dataloader` and it should "
+                "work."
+            )
 
-    def _get_iterator_for_epoch(self, epoch, shuffle, fix_batches_to_gpus=False,
-                                offset=0):
-        def shuffle_batches(batches, seed):
+    # -- epoch planning ------------------------------------------------------
+
+    def _plan_shard(self, epoch, shuffle, fix_batches_to_gpus):
+        """This host's padded batch list for ``epoch``.
+
+        Order is deterministic in (seed, epoch); with ``fix_batches_to_gpus``
+        the shard split happens before shuffling (so each host keeps the
+        same batches across epochs) and the shuffle is per-host-seeded.
+        """
+
+        def reshuffled(batches, seed):
+            batches = list(batches)
             with data_utils.numpy_seed(seed):
                 np.random.shuffle(batches)
             return batches
 
+        batches = self.frozen_batches
+        if shuffle and not fix_batches_to_gpus:
+            batches = reshuffled(batches, self.seed + epoch)
+        shard = list(
+            ShardedIterator(
+                batches, self.num_shards, self.shard_id, fill_value=[]
+            )
+        )
         if self._supports_prefetch:
-            batches = self.frozen_batches
-            if shuffle and not fix_batches_to_gpus:
-                batches = shuffle_batches(list(batches), self.seed + epoch)
-            batches = list(
-                ShardedIterator(batches, self.num_shards, self.shard_id, fill_value=[])
-            )
-            self.dataset.prefetch([i for s in batches for i in s])
-            if shuffle and fix_batches_to_gpus:
-                batches = shuffle_batches(batches, self.seed + epoch + self.shard_id)
-        else:
-            if shuffle:
-                batches = shuffle_batches(list(self.frozen_batches), self.seed + epoch)
-            else:
-                batches = self.frozen_batches
-            batches = list(
-                ShardedIterator(batches, self.num_shards, self.shard_id, fill_value=[])
-            )
+            self.dataset.prefetch([i for b in shard for i in b])
+        if shuffle and fix_batches_to_gpus:
+            shard = reshuffled(shard, self.seed + epoch + self.shard_id)
+        return shard
 
-        if offset > 0 and offset >= len(batches):
-            return None
-
+    def _get_iterator_for_epoch(self, epoch, shuffle, fix_batches_to_gpus=False,
+                                offset=0):
+        shard = self._plan_shard(epoch, shuffle, fix_batches_to_gpus)
+        if offset > 0 and offset >= len(shard):
+            return None  # position beyond the epoch: caller decides
         itr = _MapLoaderIterator(
             self.dataset,
             self.collate_fn,
-            batches[offset:],
+            shard[offset:],
             num_workers=self.num_workers,
         )
-
         if self.buffer_size > 0:
             itr = BufferedIterator(self.buffer_size, itr)
+        return CountingIterator(itr, start=offset, total=len(shard))
 
-        itr = CountingIterator(itr, start=offset, total=len(batches))
-        return itr
 
 
 class _MapLoaderIterator(object):
@@ -342,147 +346,141 @@ class _MapLoaderIterator(object):
         if self.num_workers <= 0:
             for batch in self.batch_sampler:
                 yield self._load(batch)
-        else:
-            with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-                window = self.num_workers * 2
-                futures = []
-                sampler_iter = iter(self.batch_sampler)
-                for batch in itertools.islice(sampler_iter, window):
-                    futures.append(pool.submit(self._load, batch))
-                while futures:
-                    fut = futures.pop(0)
-                    for batch in itertools.islice(sampler_iter, 1):
-                        futures.append(pool.submit(self._load, batch))
-                    yield fut.result()
+            return
+        # keep ~2 batches in flight per worker, yielding strictly in order
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending = []
+            source = iter(self.batch_sampler)
+            for batch in itertools.islice(source, self.num_workers * 2):
+                pending.append(pool.submit(self._load, batch))
+            while pending:
+                head = pending.pop(0)
+                nxt = next(source, None)
+                if nxt is not None:
+                    pending.append(pool.submit(self._load, nxt))
+                yield head.result()
 
 
 class GroupedIterator(CountingIterator):
-    """Wrapper around an iterable that returns groups (chunks) of items —
-    the gradient-accumulation micro-batch grouping
-    (reference iterators.py:406-435)."""
+    """Chunks of ``chunk_size`` consecutive batches — the gradient-
+    accumulation grouping (reference iterators.py:406-435)."""
 
     def __init__(self, iterable, chunk_size):
-        itr = _chunk_iterator(iterable, chunk_size)
+        def chunks():
+            src = iter(iterable)
+            while True:
+                block = list(itertools.islice(src, chunk_size))
+                if not block:
+                    return
+                yield block
+
         super().__init__(
-            itr,
+            chunks(),
             start=int(math.ceil(getattr(iterable, "n", 0) / float(chunk_size))),
             total=int(math.ceil(len(iterable) / float(chunk_size))),
         )
         self.chunk_size = chunk_size
 
 
-def _chunk_iterator(itr, chunk_size):
-    chunk = []
-    for x in itr:
-        chunk.append(x)
-        if len(chunk) == chunk_size:
-            yield chunk
-            chunk = []
-    if len(chunk) > 0:
-        yield chunk
-
-
 class ShardedIterator(CountingIterator):
-    """A sharded wrapper around an iterable, padded to length
-    (reference iterators.py:438-468)."""
+    """Round-robin shard of an iterable, padded with ``fill_value`` so every
+    shard has the same length (reference iterators.py:438-468)."""
 
     def __init__(self, iterable, num_shards, shard_id, fill_value=None):
-        if shard_id < 0 or shard_id >= num_shards:
+        if not 0 <= shard_id < num_shards:
             raise ValueError("shard_id must be between 0 and num_shards")
-        sharded_len = int(math.ceil(len(iterable) / float(num_shards)))
-        itr = map(
-            operator.itemgetter(1),
-            itertools.zip_longest(
-                range(sharded_len),
-                itertools.islice(iterable, shard_id, len(iterable), num_shards),
-                fillvalue=fill_value,
-            ),
-        )
+        padded_len = int(math.ceil(len(iterable) / float(num_shards)))
+
+        def sharded():
+            count = 0
+            for i, item in enumerate(iterable):
+                if i % num_shards == shard_id:
+                    count += 1
+                    yield item
+            while count < padded_len:
+                count += 1
+                yield fill_value
+
         super().__init__(
-            itr,
+            sharded(),
             start=int(math.ceil(getattr(iterable, "n", 0) / float(num_shards))),
-            total=sharded_len,
+            total=padded_len,
         )
-
-
-class BackgroundConsumer(threading.Thread):
-    def __init__(self, queue, source, max_len):
-        threading.Thread.__init__(self)
-
-        self._queue = queue
-        self._source = source
-        self._max_len = max_len
-        self.count = 0
-
-    def run(self):
-        try:
-            for item in self._source:
-                self._queue.put(item)
-                # Stop if we reached the maximum length
-                self.count += 1
-                if self._max_len is not None and self.count >= self._max_len:
-                    break
-            # Signal the consumer we are done.
-            self._queue.put(_sentinel)
-        except Exception as e:
-            self._queue.put(e)
 
 
 class BufferedIterator(object):
-    """Background-thread prefetch of up to ``size`` ready batches with a
-    slow-loader warning (reference iterators.py:471-554)."""
+    """Producer-thread prefetch of up to ``size`` ready batches.
+
+    The producer pushes batches (or its terminating exception) into a
+    bounded queue; the consumer warns — at most every 15 minutes, and only
+    after the first 5 minutes of a run — when the buffer runs near empty,
+    which indicates the data pipeline can't keep up with the device
+    (reference iterators.py:471-554's bottleneck warning).
+    """
+
+    _RUNTIME_BEFORE_WARN = 5 * 60
+    _WARN_EVERY = 15 * 60
 
     def __init__(self, size, iterable):
         self._queue = queue.Queue(size)
         self._iterable = iterable
-        self._consumer = None
-
-        self.start_time = time.time()
-        self.warning_time = None
-
+        self._producer = None
+        self._started = time.time()
+        self._last_warn = None
         self.total = len(iterable)
 
-    def _create_consumer(self):
-        self._consumer = BackgroundConsumer(self._queue, self._iterable, self.total)
-        self._consumer.daemon = True
-        self._consumer.start()
+    def _start_producer(self):
+        def pump():
+            try:
+                sent = 0
+                for item in self._iterable:
+                    self._queue.put(item)
+                    sent += 1
+                    if self.total is not None and sent >= self.total:
+                        break
+                self._queue.put(_DONE)
+            except Exception as e:
+                self._queue.put(e)
 
-    def __iter__(self):
-        return self
+        self._producer = threading.Thread(
+            target=pump, name="buffered-iterator-producer", daemon=True
+        )
+        self._producer.start()
 
     def __len__(self):
         return self.total
 
+    def __iter__(self):
+        return self
+
     def take(self, n):
         self.total = min(self.total, n)
-        # Propagate this change to the underlying iterator
         if hasattr(self._iterable, "take"):
             self._iterable.take(n)
         return self
 
+    def _maybe_warn_starved(self):
+        if self._queue.qsize() >= min(2, max(1, self._queue.maxsize // 2)):
+            return
+        now = time.time()
+        if now - self._started <= self._RUNTIME_BEFORE_WARN:
+            return
+        if self._last_warn is not None and now - self._last_warn <= self._WARN_EVERY:
+            return
+        logger.debug(
+            "Data loading buffer is empty or nearly empty. This may "
+            "indicate a data loading bottleneck, and increasing the "
+            "number of workers (--num-workers) may help."
+        )
+        self._last_warn = now
+
     def __next__(self):
-        # Create consumer if not created yet
-        if self._consumer is None:
-            self._create_consumer()
-
-        # Notify the user if there is a data loading bottleneck
-        if self._queue.qsize() < min(2, max(1, self._queue.maxsize // 2)):
-            if time.time() - self.start_time > 5 * 60:
-                if (
-                    self.warning_time is None
-                    or time.time() - self.warning_time > 15 * 60
-                ):
-                    logger.debug(
-                        "Data loading buffer is empty or nearly empty. This may "
-                        "indicate a data loading bottleneck, and increasing the "
-                        "number of workers (--num-workers) may help."
-                    )
-                    self.warning_time = time.time()
-
-        # Get next example
+        if self._producer is None:
+            self._start_producer()
+        self._maybe_warn_starved()
         item = self._queue.get(True)
         if isinstance(item, Exception):
             raise item
-        if item is _sentinel:
+        if item is _DONE:
             raise StopIteration()
         return item

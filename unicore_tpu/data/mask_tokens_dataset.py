@@ -1,11 +1,15 @@
-"""BERT-style token masking
-(reference /root/reference/unicore/data/mask_tokens_dataset.py:19-131).
+"""BERT-style token corruption for masked-LM training.
 
-Behavioral parity: per-(epoch, index) seeded masking with probabilistic
-rounding of the mask count, first/last positions never masked, split into
-mask / leave-unmasked / random-replacement per the usual 15%/10%/10% scheme,
-and a paired target view with pad everywhere except masked positions.
-Numpy-only (no torch); results LRU-cached per epoch.
+Parity surface (reference
+/root/reference/unicore/data/mask_tokens_dataset.py:19-131): per-
+(seed, epoch, index) deterministic masking with probabilistic rounding of
+the mask count, first/last positions never touched, the usual
+mask/keep/random-replace split, and a paired target view that is pad
+everywhere except the masked positions.  Implementation original to this
+framework: the source and target views share the same leading rng draws (so
+they agree on the mask), and the per-position fate is one categorical draw
+instead of the reference's two-stage uniform scheme — identical
+distribution, simpler code.
 """
 
 from functools import lru_cache
@@ -17,16 +21,22 @@ from .base_wrapper_dataset import BaseWrapperDataset
 from .dictionary import Dictionary
 from .lru_cache_dataset import LRUCacheDataset
 
+# fates for a chosen position
+_MASK, _KEEP, _RANDOM = 0, 1, 2
+
 
 class MaskTokensDataset(BaseWrapperDataset):
     @classmethod
     def apply_mask(cls, dataset, *args, **kwargs):
-        """Return (source, target) dataset views for masked-LM training."""
+        """Return (source, target) views over the same underlying items.
+
+        The base dataset is LRU-wrapped so the two views don't double-read
+        it, and each view is LRU-wrapped so repeated collate passes don't
+        re-draw the noise."""
         dataset = LRUCacheDataset(dataset)
-        return (
-            LRUCacheDataset(cls(dataset, *args, **kwargs, return_masked_tokens=False)),
-            LRUCacheDataset(cls(dataset, *args, **kwargs, return_masked_tokens=True)),
-        )
+        src = cls(dataset, *args, **kwargs, return_masked_tokens=False)
+        tgt = cls(dataset, *args, **kwargs, return_masked_tokens=True)
+        return LRUCacheDataset(src), LRUCacheDataset(tgt)
 
     def __init__(
         self,
@@ -54,17 +64,18 @@ class MaskTokensDataset(BaseWrapperDataset):
         self.mask_prob = mask_prob
         self.leave_unmasked_prob = leave_unmasked_prob
         self.random_token_prob = random_token_prob
+        self.epoch = None
 
         if random_token_prob > 0.0:
-            weights = np.ones(len(self.vocab))
-            weights[vocab.special_index()] = 0
-            self.weights = weights / weights.sum()
-
-        self.epoch = None
+            # replacement tokens are drawn uniformly over the non-special
+            # vocabulary
+            w = np.ones(len(vocab))
+            w[vocab.special_index()] = 0
+            self.weights = w / w.sum()
 
     @property
     def can_reuse_epoch_itr_across_epochs(self):
-        return True  # only the noise changes per epoch, not item sizes
+        return True  # item sizes are epoch-independent; only the noise moves
 
     def set_epoch(self, epoch, **unused):
         super().set_epoch(epoch)
@@ -76,49 +87,39 @@ class MaskTokensDataset(BaseWrapperDataset):
     @lru_cache(maxsize=16)
     def __getitem_cached__(self, epoch: int, index: int):
         with data_utils.numpy_seed(self.seed, epoch, index):
-            item = np.asarray(self.dataset[index])
-            sz = len(item)
-            assert sz > 2, "cannot mask an empty sequence"
-            assert self.mask_idx not in item, (
-                f"Dataset contains mask_idx (={self.mask_idx}), this is not expected!"
+            tokens = np.asarray(self.dataset[index])
+            n = len(tokens)
+            assert n > 2, "cannot mask an empty sequence"
+            assert self.mask_idx not in tokens, (
+                f"Dataset contains mask_idx (={self.mask_idx}), "
+                "this is not expected!"
             )
 
-            # choose positions to corrupt; probabilistic rounding via +rand()
-            mask = np.full(sz, False)
-            num_mask = int(self.mask_prob * (sz - 2) + np.random.rand())
-            mask_idc = np.random.choice(sz - 2, num_mask, replace=False) + 1
-            mask[mask_idc] = True
+            # Interior positions only ([CLS]/[SEP] stay clean).  The count
+            # rounds probabilistically: floor(p*(n-2) + U) has expectation
+            # exactly p*(n-2).  These two draws are the shared prefix that
+            # keeps the source and target views in agreement.
+            count = int(self.mask_prob * (n - 2) + np.random.rand())
+            chosen = 1 + np.random.choice(n - 2, count, replace=False)
 
             if self.return_masked_tokens:
-                # target view: original token at masked positions, pad elsewhere
-                target = np.full(sz, self.pad_idx, dtype=item.dtype)
-                target[mask] = item[mask]
+                target = np.full_like(tokens, self.pad_idx)
+                target[chosen] = tokens[chosen]
                 return target
 
-            # split the masked set into [MASK] / keep-original / random-token
-            rand_or_unmask_prob = self.random_token_prob + self.leave_unmasked_prob
-            unmask = rand_mask = None
-            if rand_or_unmask_prob > 0.0:
-                rand_or_unmask = mask & (np.random.rand(sz) < rand_or_unmask_prob)
-                if self.random_token_prob == 0.0:
-                    unmask = rand_or_unmask
-                elif self.leave_unmasked_prob == 0.0:
-                    rand_mask = rand_or_unmask
-                else:
-                    unmask_prob = self.leave_unmasked_prob / rand_or_unmask_prob
-                    decision = np.random.rand(sz) < unmask_prob
-                    unmask = rand_or_unmask & decision
-                    rand_mask = rand_or_unmask & (~decision)
-
-            if unmask is not None:
-                mask = mask ^ unmask
-
-            new_item = np.copy(item)
-            new_item[mask] = self.mask_idx
-            if rand_mask is not None:
-                num_rand = rand_mask.sum()
-                if num_rand > 0:
-                    new_item[rand_mask] = np.random.choice(
-                        len(self.vocab), num_rand, p=self.weights
-                    )
-            return new_item
+            corrupted = tokens.copy()
+            p_keep = self.leave_unmasked_prob
+            p_rand = self.random_token_prob
+            if p_keep + p_rand > 0.0:
+                fate = np.random.choice(
+                    3, size=count, p=[1.0 - p_keep - p_rand, p_keep, p_rand]
+                )
+            else:
+                fate = np.zeros(count, dtype=np.int64)
+            corrupted[chosen[fate == _MASK]] = self.mask_idx
+            rand_positions = chosen[fate == _RANDOM]
+            if rand_positions.size:
+                corrupted[rand_positions] = np.random.choice(
+                    len(self.vocab), rand_positions.size, p=self.weights
+                )
+            return corrupted

@@ -139,7 +139,14 @@ def all_reduce(tensor, op="sum"):
 def all_gather_list(data, group=None, max_size=None):
     """Gather arbitrary picklable data from all hosts
     (reference utils.py:275-349 — pickle over a byte tensor; here
-    multihost_utils handles the byte plumbing)."""
+    multihost_utils handles the byte plumbing).
+
+    With ``max_size=None`` (default) the buffer is auto-sized in two phases:
+    an 8-byte length gather first, then a payload gather padded to the
+    LARGEST host's length — so payloads of any size work and small payloads
+    never pay for a large fixed buffer.  Passing ``max_size`` keeps the
+    reference's single-round fixed-buffer behavior (one collective instead
+    of two; errors if the payload doesn't fit)."""
     if jax.process_count() == 1:
         return [data]
     import pickle
@@ -147,12 +154,18 @@ def all_gather_list(data, group=None, max_size=None):
     from jax.experimental import multihost_utils
 
     payload = np.frombuffer(pickle.dumps(data), dtype=np.uint8)
-    max_size = max_size or 2 ** 20
-    if len(payload) > max_size - 8:
-        raise ValueError(
-            f"encoded data size ({len(payload)}) exceeds max_size ({max_size})"
+    if max_size is not None:
+        if len(payload) > max_size - 8:
+            raise ValueError(
+                f"encoded data size ({len(payload)}) exceeds max_size ({max_size})"
+            )
+        pad_to = max_size - 8
+    else:
+        lengths = multihost_utils.process_allgather(
+            np.asarray([len(payload)], dtype=np.uint64)
         )
-    buf = np.zeros((max_size,), dtype=np.uint8)
+        pad_to = int(np.asarray(lengths).max())
+    buf = np.zeros((8 + pad_to,), dtype=np.uint8)
     header = np.frombuffer(
         np.asarray([len(payload)], dtype=np.uint64).tobytes(), dtype=np.uint8
     )
@@ -175,6 +188,66 @@ def all_reduce_dict(data: Dict[str, Any], device=None, group=None) -> Dict[str, 
     vec = np.asarray([float(data[k]) for k in keys], dtype=np.float64)
     out = all_reduce(vec, op="sum")
     return {k: out[i] for i, k in enumerate(keys)}
+
+
+def all_to_all(tensor, group=None):
+    """Host-level all-to-all: row block i of this host's array is delivered
+    to host i; the result holds one row block from every host
+    (reference utils.py:251-259 — dist.all_to_all_single).
+
+    The input's leading dim must be divisible by the process count.  Built on
+    one allgather + a local slice: host j keeps block j of every gathered
+    row.  In-jit data-plane all-to-alls are emitted by XLA from shardings
+    (or ``lax.all_to_all`` inside shard_map); this helper covers host-side
+    control-plane use only.
+    """
+    if jax.process_count() == 1:
+        return np.asarray(tensor)
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(tensor)
+    n = jax.process_count()
+    if arr.shape[0] % n != 0:
+        raise ValueError(
+            f"all_to_all leading dim {arr.shape[0]} not divisible by "
+            f"process count {n}"
+        )
+    rows = arr.shape[0] // n
+    me = jax.process_index()
+    gathered = multihost_utils.process_allgather(arr)  # (n, rows*n, ...)
+    return np.concatenate(
+        [gathered[src, me * rows : (me + 1) * rows] for src in range(n)], axis=0
+    )
+
+
+def broadcast_tensors(tensors, src_rank=0, group=None, dist_device=None):
+    """Broadcast a list of arrays from one host; non-source hosts pass None
+    and receive the values (reference utils.py:406-445 — shape/dtype
+    metadata first, then each tensor)."""
+    if jax.process_count() == 1:
+        return tensors
+    from jax.experimental import multihost_utils
+
+    is_source = jax.process_index() == src_rank
+    meta = (
+        [(tuple(t.shape), np.dtype(t.dtype).name) for t in tensors]
+        if is_source
+        else None
+    )
+    meta = broadcast_object(meta, src_rank=src_rank)
+    out = []
+    for i, (shape, dtype) in enumerate(meta):
+        buf = (
+            np.ascontiguousarray(np.asarray(tensors[i]))
+            if is_source
+            else np.zeros(shape, dtype=dtype)
+        )
+        out.append(
+            np.asarray(
+                multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+            )
+        )
+    return out
 
 
 def broadcast_object(obj, src_rank=0, group=None):

@@ -1,13 +1,21 @@
-"""Dynamic loss scaling (reference /root/reference/unicore/optim/dynamic_loss_scaler.py:8-71).
+"""Dynamic fp16 loss scaling.
 
-Two faces:
-- :class:`DynamicLossScaler` — host-side mirror with the reference's API
-  (check_overflow raising OverflowError, update schedule) for code that
-  drives training from Python;
-- :func:`update_scale` — the branchless jit-side version the trainer embeds
-  in the compiled step: overflow detection and the x2/÷2 schedule as pure
-  arithmetic on carried scalars, so an fp16 overflow skip costs no host
-  round-trip.
+Parity surface (reference
+/root/reference/unicore/optim/dynamic_loss_scaler.py:8-71): grow the scale
+after a clean window, shrink on overflow subject to a tolerated-overflow
+percentage, and abort training when the scale pins at ``min_loss_scale``.
+Two faces, both original implementations:
+
+- :func:`scale_schedule` / :func:`init_scale_state` — the jit-side form the
+  trainer embeds in the compiled step.  The whole schedule, including the
+  tolerance percentage, is branchless arithmetic on four carried scalars,
+  so an overflow skip costs no host round-trip (the reference raises
+  ``OverflowError`` through Python per overflow).  The min-scale abort
+  surfaces as a ``pinned`` flag the trainer raises on at its next metrics
+  flush (reference aborts synchronously).
+- :class:`DynamicLossScaler` — host-side class with the reference's API
+  (``check_overflow`` raising, ``update`` growing) for code that drives
+  training from Python; counter state mirrors the jit form.
 """
 
 import logging
@@ -17,7 +25,92 @@ import jax.numpy as jnp
 logger = logging.getLogger(__name__)
 
 
+def init_scale_state(init_scale):
+    """Carried scalars for the jit-side schedule."""
+    return {
+        "scale": jnp.asarray(float(init_scale), dtype=jnp.float32),
+        "since_overflow": jnp.zeros((), dtype=jnp.int32),
+        "since_rescale": jnp.zeros((), dtype=jnp.int32),
+        "overflows_since_rescale": jnp.zeros((), dtype=jnp.int32),
+    }
+
+
+def scale_schedule(
+    state,
+    overflow,
+    scale_factor=2.0,
+    scale_window=2000,
+    min_loss_scale=1e-4,
+    tolerance=0.0,
+):
+    """One step of the schedule, branchless.
+
+    - clean step: ``since_overflow + 1`` hitting a multiple of
+      ``scale_window`` grows the scale by ``scale_factor``;
+    - overflow: shrink only when the overflow percentage since the last
+      rescale reaches ``tolerance`` (tolerance 0 shrinks on every overflow);
+    - ``pinned`` is True when a due shrink ran into ``min_loss_scale`` —
+      the caller should abort (reference raises FloatingPointError).
+
+    Returns ``(new_state, pinned)``.
+    """
+    scale = state["scale"]
+    since_overflow = state["since_overflow"]
+    since_rescale = state["since_rescale"]
+    overflows = state["overflows_since_rescale"]
+
+    new_overflows = overflows + overflow.astype(jnp.int32)
+    steps = jnp.maximum(since_rescale + 1, 1).astype(jnp.float32)
+    pct = new_overflows.astype(jnp.float32) / steps
+    shrink_due = overflow & (pct >= tolerance)
+    grow_due = (~overflow) & ((since_overflow + 1) % scale_window == 0)
+
+    shrunk = jnp.maximum(scale / scale_factor, min_loss_scale)
+    new_scale = jnp.where(
+        shrink_due, shrunk, jnp.where(grow_due, scale * scale_factor, scale)
+    )
+    pinned = shrink_due & (scale / scale_factor <= min_loss_scale)
+
+    rescaled = shrink_due | grow_due
+    new_state = {
+        "scale": new_scale,
+        "since_overflow": jnp.where(overflow, 0, since_overflow + 1),
+        "since_rescale": jnp.where(rescaled, 0, since_rescale + 1),
+        "overflows_since_rescale": jnp.where(rescaled, 0, new_overflows),
+    }
+    return new_state, pinned
+
+
+def update_scale(
+    loss_scale,
+    since_overflow,
+    overflow,
+    scale_factor=2.0,
+    scale_window=2000,
+    min_loss_scale=1e-4,
+):
+    """Tolerance-free compat form over (scale, since_overflow) scalars only:
+    every overflow shrinks.  Returns (new_scale, new_since_overflow)."""
+    state = {
+        "scale": jnp.asarray(loss_scale, dtype=jnp.float32),
+        "since_overflow": jnp.asarray(since_overflow, dtype=jnp.int32),
+        "since_rescale": jnp.zeros((), dtype=jnp.int32),
+        "overflows_since_rescale": jnp.zeros((), dtype=jnp.int32),
+    }
+    new_state, _ = scale_schedule(
+        state,
+        overflow,
+        scale_factor=scale_factor,
+        scale_window=scale_window,
+        min_loss_scale=min_loss_scale,
+        tolerance=0.0,
+    )
+    return new_state["scale"], new_state["since_overflow"]
+
+
 class DynamicLossScaler(object):
+    """Host-side scaler with the reference's exception-driven API."""
+
     def __init__(
         self,
         init_scale=2.0 ** 15,
@@ -32,74 +125,48 @@ class DynamicLossScaler(object):
         self.scale_window = scale_window
         self.tolerance = tolerance
         self.threshold = threshold
-        self._iter = 0
-        self._last_overflow_iter = -1
-        self._last_rescale_iter = -1
-        self._overflows_since_rescale = 0
         self.min_loss_scale = min_loss_scale
+        # counters mirror the jit-side carried scalars
+        self._since_overflow = 0
+        self._since_rescale = 0
+        self._overflows_since_rescale = 0
 
     def scale(self, outputs):
         return self.loss_scale * outputs
 
     def update(self):
-        if (self._iter - self._last_overflow_iter) % self.scale_window == 0:
+        """Record a clean step; grows the scale when a full window of them
+        has passed since the last overflow."""
+        self._since_overflow += 1
+        self._since_rescale += 1
+        if self._since_overflow % self.scale_window == 0:
             self.loss_scale *= self.scale_factor
-            self._last_rescale_iter = self._iter
-        self._iter += 1
-
-    def _decrease_loss_scale(self):
-        self.loss_scale /= self.scale_factor
-        if self.threshold is not None:
-            self.loss_scale = max(self.loss_scale, self.threshold)
+            self._since_rescale = 0
+            self._overflows_since_rescale = 0
 
     def check_overflow(self, grad_norm):
-        # detect inf and nan
-        if grad_norm == float("inf") or grad_norm != grad_norm:
-            # overflow has occurred
-            prev_scale = self.loss_scale
-            iter_since_rescale = self._iter - self._last_rescale_iter
-
-            self._last_overflow_iter = self._iter
-            self._overflows_since_rescale += 1
-            pct_overflow = self._overflows_since_rescale / float(iter_since_rescale)
-            if pct_overflow >= self.tolerance:
-                self._decrease_loss_scale()
-                self._last_rescale_iter = self._iter
-                self._overflows_since_rescale = 0
-
-            if self.loss_scale <= self.min_loss_scale:
-                # Use FloatingPointError as an uncommon error that parent
-                # functions can safely catch to stop training.
-                self.loss_scale = prev_scale
+        """No-op on finite norms.  On inf/nan: shrink the scale if the
+        overflow percentage since the last rescale reaches the tolerance,
+        then raise OverflowError so the caller skips the step — or
+        FloatingPointError when the shrink hit ``min_loss_scale``."""
+        if not (grad_norm == float("inf") or grad_norm != grad_norm):
+            return
+        self._overflows_since_rescale += 1
+        self._since_overflow = 0
+        pct = self._overflows_since_rescale / float(max(self._since_rescale + 1, 1))
+        self._since_rescale += 1
+        if pct >= self.tolerance:
+            shrunk = self.loss_scale / self.scale_factor
+            if self.threshold is not None:
+                shrunk = max(shrunk, self.threshold)
+            if shrunk <= self.min_loss_scale:
                 raise FloatingPointError(
-                    (
-                        "Minimum loss scale reached ({}). Your loss is probably exploding. "
-                        "Try lowering the learning rate, using gradient clipping or "
-                        "increasing the batch size."
-                    ).format(self.min_loss_scale)
+                    f"Minimum loss scale reached ({self.min_loss_scale}). "
+                    "Your loss is probably exploding. Try lowering the "
+                    "learning rate, using gradient clipping or increasing "
+                    "the batch size."
                 )
-
-            self._iter += 1
-            raise OverflowError("setting loss scale to: " + str(self.loss_scale))
-
-
-def update_scale(
-    loss_scale,
-    since_overflow,
-    overflow,
-    scale_factor=2.0,
-    scale_window=2000,
-    min_loss_scale=1e-4,
-):
-    """Branchless jit-side loss-scale schedule.
-
-    Args are jnp scalars carried in TrainState: current scale, steps since
-    the last overflow, and this step's overflow flag.  Returns
-    (new_scale, new_since_overflow).
-    """
-    shrunk = jnp.maximum(loss_scale / scale_factor, min_loss_scale)
-    grown_due = (since_overflow + 1) % scale_window == 0
-    grown = jnp.where(grown_due, loss_scale * scale_factor, loss_scale)
-    new_scale = jnp.where(overflow, shrunk, grown)
-    new_since = jnp.where(overflow, 0, since_overflow + 1)
-    return new_scale, new_since
+            self.loss_scale = shrunk
+            self._since_rescale = 0
+            self._overflows_since_rescale = 0
+        raise OverflowError(f"setting loss scale to: {self.loss_scale}")
