@@ -1,0 +1,13 @@
+"""A ``--user-dir`` plugin package.
+
+Passing ``--user-dir examples/custom_task`` to ``unicore-train`` imports
+this package (unicore_tpu/utils/__init__.py import_user_module, mirroring
+reference utils.py:138-171); the imports below run the ``@register_*``
+decorators, making the task/model/loss visible to the CLI exactly like
+bundled ones.  This is the extension route downstream projects use
+(SURVEY.md §1: Uni-Mol and Uni-Fold are user-dir plugins of the reference).
+"""
+
+from . import task  # noqa
+from . import model  # noqa
+from . import loss  # noqa

@@ -1,0 +1,39 @@
+"""Plugin loss: mean-squared error over per-sequence scalars.
+
+Shape mirrors a reference plugin loss (``@register_loss`` + the
+``(loss, sample_size, logging_output)`` contract of
+unicore/losses/unicore_loss.py:59-66).
+"""
+
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+from unicore_tpu.losses import register_loss
+from unicore_tpu.losses.unicore_loss import UnicoreLoss
+
+
+@register_loss("l2_regression")
+class L2RegressionLoss(UnicoreLoss):
+    def forward(self, model, params, sample, rngs=None, train=True):
+        pred = model.apply(
+            params, **sample["net_input"], train=train, rngs=rngs
+        )
+        target = sample["target"].astype(jnp.float32)
+        loss = jnp.sum((pred.astype(jnp.float32) - target) ** 2)
+        sample_size = jnp.asarray(target.shape[0], dtype=jnp.float32)
+        logging_output = {
+            "loss": loss,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+            "sample_size": sample_size,
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        loss_sum = sum(log.get("loss", 0) for log in logging_outputs)
+        sample_size = sum(log.get("sample_size", 0) for log in logging_outputs)
+        metrics.log_scalar("loss", loss_sum / sample_size, sample_size, round=5)
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

@@ -1,0 +1,85 @@
+"""Plugin model: tiny transformer regressor.
+
+Shape mirrors the reference's plugin model (reference examples/bert/model.py:
+``@register_model`` + add_args + ``build_model`` + arch functions), built on
+this framework's module library: a TransformerEncoder trunk with a
+mean-pooled scalar head.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu.models import (
+    register_model,
+    register_model_architecture,
+)
+from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.modules import LayerNorm, TransformerEncoder
+
+
+@register_model("toy_regressor")
+class ToyRegressorModel(BaseUnicoreModel):
+    vocab_size: int = 64
+    padding_idx: int = 0
+    encoder_layers: int = 2
+    encoder_embed_dim: int = 64
+    encoder_ffn_embed_dim: int = 128
+    encoder_attention_heads: int = 4
+    max_seq_len: int = 64
+    dropout: float = 0.1
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("--encoder-layers", type=int, metavar="L")
+        parser.add_argument("--encoder-embed-dim", type=int, metavar="H")
+        parser.add_argument("--encoder-ffn-embed-dim", type=int, metavar="F")
+        parser.add_argument("--encoder-attention-heads", type=int, metavar="A")
+        parser.add_argument("--dropout", type=float, metavar="D")
+
+    @classmethod
+    def build_model(cls, args, task):
+        toy_base_architecture(args)
+        return cls(
+            vocab_size=args.toy_vocab,
+            padding_idx=task.dictionary.pad(),
+            encoder_layers=args.encoder_layers,
+            encoder_embed_dim=args.encoder_embed_dim,
+            encoder_ffn_embed_dim=args.encoder_ffn_embed_dim,
+            encoder_attention_heads=args.encoder_attention_heads,
+            max_seq_len=args.toy_seq_len,
+            dropout=args.dropout,
+        )
+
+    @nn.compact
+    def __call__(self, src_tokens, train: bool = False, **unused):
+        pad_mask = src_tokens == self.padding_idx
+        x = nn.Embed(self.vocab_size, self.encoder_embed_dim)(src_tokens)
+        x = LayerNorm(self.encoder_embed_dim)(x)
+        x = TransformerEncoder(
+            encoder_layers=self.encoder_layers,
+            embed_dim=self.encoder_embed_dim,
+            ffn_embed_dim=self.encoder_ffn_embed_dim,
+            attention_heads=self.encoder_attention_heads,
+            max_seq_len=self.max_seq_len,
+            dropout=self.dropout,
+        )(x, padding_mask=pad_mask, train=train)
+        # masked mean pool over valid positions -> scalar per sequence
+        valid = (~pad_mask)[..., None].astype(x.dtype)
+        pooled = (x * valid).sum(axis=1) / jnp.maximum(valid.sum(axis=1), 1.0)
+        out = nn.Dense(1)(pooled.astype(jnp.float32))
+        return jnp.tanh(out[..., 0])
+
+
+@register_model_architecture("toy_regressor", "toy_regressor")
+def toy_base_architecture(args):
+    args.encoder_layers = getattr(args, "encoder_layers", 2)
+    args.encoder_embed_dim = getattr(args, "encoder_embed_dim", 64)
+    args.encoder_ffn_embed_dim = getattr(args, "encoder_ffn_embed_dim", 128)
+    args.encoder_attention_heads = getattr(args, "encoder_attention_heads", 4)
+    args.dropout = getattr(args, "dropout", 0.1)
+
+
+@register_model_architecture("toy_regressor", "toy_regressor_deep")
+def toy_deep_architecture(args):
+    args.encoder_layers = getattr(args, "encoder_layers", 4)
+    toy_base_architecture(args)

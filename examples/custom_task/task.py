@@ -1,0 +1,87 @@
+"""Plugin task: sequence regression on synthetic data.
+
+Shape mirrors the reference's plugin task (reference examples/bert/task.py:
+``@register_task`` + add_args + load_dataset building a composed pipeline),
+but demonstrates a task the framework does NOT bundle: predict a scalar from
+a token sequence.  Data is generated on the fly so the example needs no
+corpus download.
+"""
+
+import logging
+
+import numpy as np
+
+from unicore_tpu.data import (
+    EpochShuffleDataset,
+    NestedDictionaryDataset,
+    RawArrayDataset,
+    RawLabelDataset,
+)
+from unicore_tpu.tasks import register_task
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+
+logger = logging.getLogger(__name__)
+
+
+def synthesize(n_samples, seq_len, vocab, seed):
+    """Token sequences whose target is a smooth function of their content —
+    learnable, so the example's loss visibly decreases."""
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(2, vocab, size=(n_samples, seq_len)).astype(np.int64)
+    target = np.tanh(tokens.mean(axis=1) / vocab - 0.5).astype(np.float32)
+    return tokens, target
+
+
+@register_task("toy_regression")
+class ToyRegressionTask(UnicoreTask):
+    """Regress a per-sequence scalar from token content."""
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument("data", help="unused (data is synthesized)")
+        parser.add_argument("--toy-samples", default=512, type=int,
+                            help="number of synthetic samples per split")
+        parser.add_argument("--toy-seq-len", default=32, type=int,
+                            help="sequence length of synthetic samples")
+        parser.add_argument("--toy-vocab", default=64, type=int,
+                            help="vocabulary size of synthetic samples")
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.seed = args.seed
+
+    # the bundled losses look tokens up through the task dictionary; this
+    # task only needs a pad id for the model's padding mask
+    class _Dict:
+        def pad(self):
+            return 0
+
+    dictionary = _Dict()
+
+    def load_dataset(self, split, combine=False, **kwargs):
+        n = self.args.toy_samples if split == "train" else self.args.toy_samples // 4
+        tokens, target = synthesize(
+            n,
+            self.args.toy_seq_len,
+            self.args.toy_vocab,
+            # distinct data per split
+            seed=self.seed + (0 if split == "train" else 10_000),
+        )
+        # note: only array leaves — host-local scalar leaves (e.g.
+        # NumSamplesDataset's int) would count per-host, not globally,
+        # under the trainer's global-SPMD batch assembly
+        dataset = NestedDictionaryDataset(
+            {
+                "net_input": {
+                    "src_tokens": RawArrayDataset(list(tokens)),
+                },
+                "target": RawLabelDataset(list(target)),
+            }
+        )
+        if split == "train":
+            dataset = EpochShuffleDataset(dataset, len(dataset), self.seed)
+        self.datasets[split] = dataset
+        logger.info(f"loaded {n} synthetic samples for split {split}")
+
+    def disable_shuffling(self):
+        return False

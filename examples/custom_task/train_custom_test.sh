@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Train the --user-dir plugin task end-to-end (mirrors the reference's
+# examples/bert/train_bert_test.sh plugin invocation, train_bert_test.sh:9).
+set -e
+cd "$(dirname "$0")"
+export PYTHONPATH=../..:$PYTHONPATH
+
+python -m unicore_tpu_cli.train synthetic_data \
+  --user-dir . \
+  --task toy_regression --loss l2_regression --arch toy_regressor \
+  --optimizer adam --lr-scheduler fixed --lr 1e-3 \
+  --batch-size 32 --max-update 60 --max-epoch 8 \
+  --log-interval 10 --log-format simple --no-progress-bar \
+  --save-dir ./checkpoints_test --tmp-save-dir ./checkpoints_tmp \
+  --num-workers 0 --seed 7 --required-batch-size-multiple 1 "$@"

@@ -20,6 +20,17 @@ def lines(path):
         return None
 
 
+def _ref_basename_index():
+    index = {}
+    for dirpath, _, files in os.walk(REF):
+        for fn in files:
+            index.setdefault(fn, []).append(os.path.join(dirpath, fn))
+    return index
+
+
+_REF_BY_BASENAME = _ref_basename_index()
+
+
 def ref_candidates(rel):
     """Map our path to plausible reference counterparts."""
     out = []
@@ -29,11 +40,7 @@ def ref_candidates(rel):
     if parts[0] == "unicore_tpu_cli":
         out.append(os.path.join(REF, "unicore_cli", *parts[1:]))
     out.append(os.path.join(REF, rel))
-    # same basename anywhere in the reference tree
-    base = os.path.basename(rel)
-    for dirpath, _, files in os.walk(REF):
-        if base in files:
-            out.append(os.path.join(dirpath, base))
+    out.extend(_REF_BY_BASENAME.get(os.path.basename(rel), []))
     return out
 
 

@@ -171,6 +171,35 @@ def test_evoformer_msa_e2e(tmp_path):
     assert "num_updates: 3" in out
 
 
+def test_user_dir_plugin_e2e(tmp_path):
+    """The flagship extension mechanism (SURVEY.md §1): a --user-dir plugin
+    package registers a task/model/loss via import side-effects and trains
+    through the stock CLI on the 8-device mesh, including resume."""
+    argv = [
+        "synthetic_data",
+        "--user-dir", os.path.join(REPO, "examples", "custom_task"),
+        "--task", "toy_regression", "--loss", "l2_regression",
+        "--arch", "toy_regressor",
+        "--optimizer", "adam", "--lr-scheduler", "fixed", "--lr", "1e-3",
+        "--batch-size", "8", "--max-update", "8", "--max-epoch", "100",
+        "--toy-samples", "128", "--toy-seq-len", "16",
+        "--log-interval", "2", "--log-format", "simple",
+        "--save-dir", str(tmp_path / "ckpt"),
+        "--tmp-save-dir", str(tmp_path / "tmp"),
+        "--num-workers", "0", "--seed", "7", "--no-progress-bar",
+        "--required-batch-size-multiple", "1",
+    ]
+    out = run_cli(argv)
+    assert "num_updates: 8" in out
+    assert "loaded 128 synthetic samples" in out  # plugin task ran
+    assert os.path.exists(tmp_path / "ckpt" / "checkpoint_last.pt")
+    # resume picks the plugin back up through --user-dir
+    argv[argv.index("--max-update") + 1] = "12"
+    out2 = run_cli(argv)
+    assert "Loaded checkpoint" in out2
+    assert "num_updates: 12" in out2
+
+
 def test_orbax_checkpoint_format_e2e(data_dir, tmp_path):
     args = common_args(data_dir, str(tmp_path), 6) + [
         "--checkpoint-format", "orbax", "--save-interval-updates", "4",

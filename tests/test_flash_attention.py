@@ -139,3 +139,42 @@ def test_module_flash_equals_fused_path():
     o1 = m_flash.apply(params, x, key_padding_mask=pm, attn_bias=bias)
     o2 = m_plain.apply(params, x, key_padding_mask=pm, attn_bias=bias)
     assert float(jnp.abs(o1 - o2).max()) < 5e-3
+
+
+def test_decoder_causal_path_uses_flash():
+    """The decoder's additive causal mask rides the flash kernel (round-1
+    verdict item 10): a causal (L,L) -inf-style bias through the flash path
+    matches the fused-softmax path, and rows attend only to the past."""
+    from unicore_tpu.modules import SelfMultiheadAttention
+
+    B, L, E, H = 2, 128, 64, 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    causal = jnp.triu(jnp.full((L, L), -1e30, jnp.float32), 1)
+    m_flash = SelfMultiheadAttention(E, H, dropout=0.0, use_flash=True)
+    m_plain = SelfMultiheadAttention(E, H, dropout=0.0, use_flash=False)
+    params = m_flash.init({"params": jax.random.PRNGKey(2)}, x, attn_bias=causal)
+    o1 = m_flash.apply(params, x, attn_bias=causal)
+    o2 = m_plain.apply(params, x, attn_bias=causal)
+    assert float(jnp.abs(o1 - o2).max()) < 5e-3
+    # causality probe: perturbing the future must not change earlier outputs
+    x2 = x.at[:, L // 2 :].add(1.0)
+    o3 = m_flash.apply(params, x2, attn_bias=causal)
+    assert float(jnp.abs(o3[:, : L // 2] - o1[:, : L // 2]).max()) < 1e-4
+
+
+def test_flash_fallback_warns_once(caplog):
+    """Rejected shapes warn (once) instead of silently running O(L^2)."""
+    import logging as _logging
+
+    from unicore_tpu.modules import multihead_attention as mha
+
+    mha._warned_fallbacks.clear()
+    B, L, E, H = 1, 96, 32, 4  # 96 is not a 128 multiple
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    m = mha.SelfMultiheadAttention(E, H, dropout=0.0, use_flash=True)
+    params = m.init({"params": jax.random.PRNGKey(1)}, x)
+    with caplog.at_level(_logging.WARNING):
+        m.apply(params, x)
+        m.apply(params, x)
+    warnings = [r for r in caplog.records if "flash attention unavailable" in r.message]
+    assert len(warnings) == 1, [r.message for r in caplog.records]

@@ -93,6 +93,13 @@ assert (a2a == exp).all(), (a2a, exp)
 bt = du.broadcast_tensors(
     [np.ones((3,)) * 7, np.arange(6).reshape(2, 3)] if rank == 0 else None)
 assert (bt[0] == 7).all() and bt[1].shape == (2, 3)
+# int64 payloads above 2**31 must survive (multihost_utils would silently
+# canonicalize int64 -> int32; the byte-view plumbing avoids that)
+big = np.asarray([2 ** 40 + 5, -(2 ** 35)], dtype=np.int64)
+bt2 = du.broadcast_tensors([big] if rank == 0 else None)
+assert bt2[0].dtype == np.int64 and (bt2[0] == big).all(), bt2
+a2a_big = du.all_to_all(np.full((2, 1), 2 ** 40 + rank, dtype=np.int64))
+assert a2a_big.dtype == np.int64 and sorted(a2a_big[:, 0] - 2 ** 40) == [0, 1]
 
 # --- build a trainer over the 4-device (dp=4) global mesh -----------------
 sys.path.insert(0, "__REPO__")

@@ -1,4 +1,12 @@
-"""Base wrapper dataset (reference /root/reference/unicore/data/base_wrapper_dataset.py:12)."""
+"""Delegating base for dataset views.
+
+Parity surface (reference
+/root/reference/unicore/data/base_wrapper_dataset.py:12): a wrapper that
+forwards the whole :class:`UnicoreDataset` protocol to ``self.dataset``, so
+views (sort, shuffle, mask, pad, ...) override only what they change.
+Delegation is explicit — a ``__getattr__`` catch-all would hide protocol
+violations in the wrapped dataset.
+"""
 
 from .unicore_dataset import UnicoreDataset
 
@@ -8,12 +16,17 @@ class BaseWrapperDataset(UnicoreDataset):
         super().__init__()
         self.dataset = dataset
 
+    # item access
     def __getitem__(self, index):
         return self.dataset[index]
 
     def __len__(self):
         return len(self.dataset)
 
+    def attr(self, attr: str, index: int):
+        return self.dataset.attr(attr, index)
+
+    # batching
     def collater(self, samples):
         return self.dataset.collater(samples)
 
@@ -26,16 +39,15 @@ class BaseWrapperDataset(UnicoreDataset):
     def ordered_indices(self):
         return self.dataset.ordered_indices()
 
+    # prefetch
     @property
     def supports_prefetch(self):
         return getattr(self.dataset, "supports_prefetch", False)
 
-    def attr(self, attr: str, index: int):
-        return self.dataset.attr(attr, index)
-
     def prefetch(self, indices):
         self.dataset.prefetch(indices)
 
+    # epoch plumbing
     @property
     def can_reuse_epoch_itr_across_epochs(self):
         return self.dataset.can_reuse_epoch_itr_across_epochs

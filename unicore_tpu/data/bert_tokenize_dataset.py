@@ -1,4 +1,9 @@
-"""WordPiece tokenization view (reference /root/reference/unicore/data/bert_tokenize_dataset.py:12)."""
+"""WordPiece tokenization view over a dataset of raw strings.
+
+Parity surface (reference
+/root/reference/unicore/data/bert_tokenize_dataset.py:12); gated on the
+optional ``tokenizers`` package.
+"""
 
 import numpy as np
 
@@ -6,30 +11,25 @@ from .base_wrapper_dataset import BaseWrapperDataset
 
 try:
     from tokenizers import BertWordPieceTokenizer
-
-    _HAS_TOKENIZERS = True
 except ImportError:
     BertWordPieceTokenizer = None
-    _HAS_TOKENIZERS = False
 
 
 class BertTokenizeDataset(BaseWrapperDataset):
     def __init__(self, dataset, dict_path: str, max_seq_len: int = 512):
-        if not _HAS_TOKENIZERS:
-            raise ImportError("BertTokenizeDataset requires the 'tokenizers' package")
+        if BertWordPieceTokenizer is None:
+            raise ImportError(
+                "BertTokenizeDataset requires the 'tokenizers' package"
+            )
         self.dataset = dataset
         self.tokenizer = BertWordPieceTokenizer(dict_path, lowercase=True)
         self.max_seq_len = max_seq_len
 
     @property
     def can_reuse_epoch_itr_across_epochs(self):
-        return True  # only the noise changes, not item sizes
+        return True  # tokenization is epoch-independent
 
     def __getitem__(self, index: int):
-        raw_str = self.dataset[index]
-        raw_str = raw_str.replace("<unk>", "[UNK]")
-        output = self.tokenizer.encode(raw_str)
-        ret = np.asarray(output.ids, dtype=np.int64)
-        if ret.shape[0] > self.max_seq_len:
-            ret = ret[: self.max_seq_len]
-        return ret
+        text = self.dataset[index].replace("<unk>", "[UNK]")
+        ids = np.asarray(self.tokenizer.encode(text).ids, dtype=np.int64)
+        return ids[: self.max_seq_len]

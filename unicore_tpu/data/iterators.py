@@ -278,9 +278,11 @@ class EpochBatchIterator(EpochBatchIterating):
     def _plan_shard(self, epoch, shuffle, fix_batches_to_gpus):
         """This host's padded batch list for ``epoch``.
 
-        Order is deterministic in (seed, epoch); with ``fix_batches_to_gpus``
-        the shard split happens before shuffling (so each host keeps the
-        same batches across epochs) and the shuffle is per-host-seeded.
+        Order is deterministic in (seed, epoch).  ``fix_batches_to_gpus``
+        only matters for prefetch-capable datasets (matching the
+        reference): the shard split happens before shuffling, so each host
+        keeps (and prefetches) the same batches every epoch, and the
+        shuffle is per-host-seeded.
         """
 
         def reshuffled(batches, seed):
@@ -289,8 +291,9 @@ class EpochBatchIterator(EpochBatchIterating):
                 np.random.shuffle(batches)
             return batches
 
+        fix_to_host = fix_batches_to_gpus and self._supports_prefetch
         batches = self.frozen_batches
-        if shuffle and not fix_batches_to_gpus:
+        if shuffle and not fix_to_host:
             batches = reshuffled(batches, self.seed + epoch)
         shard = list(
             ShardedIterator(
@@ -299,7 +302,7 @@ class EpochBatchIterator(EpochBatchIterating):
         )
         if self._supports_prefetch:
             self.dataset.prefetch([i for b in shard for i in b])
-        if shuffle and fix_batches_to_gpus:
+        if shuffle and fix_to_host:
             shard = reshuffled(shard, self.seed + epoch + self.shard_id)
         return shard
 

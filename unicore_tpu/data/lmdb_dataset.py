@@ -1,7 +1,10 @@
-"""LMDB-backed dataset (reference /root/reference/unicore/data/lmdb_dataset.py:16-49).
+"""LMDB-backed dataset of pickled samples.
 
-Pickled values keyed by stringified index, lazy per-process env open.  Gated on
-the ``lmdb`` package; environments without it can use
+Parity surface (reference
+/root/reference/unicore/data/lmdb_dataset.py:16-49): values are pickles
+keyed by stringified index; the environment opens lazily per worker
+process/thread so the dataset object stays fork/pickle-safe.  Gated on the
+``lmdb`` package; environments without it can use
 :class:`unicore_tpu.data.indexed_dataset.IndexedPickleDataset`, this
 framework's native mmap shard format, which needs no third-party reader.
 """
@@ -16,49 +19,57 @@ logger = logging.getLogger(__name__)
 
 try:
     import lmdb
-
-    _HAS_LMDB = True
 except ImportError:
     lmdb = None
-    _HAS_LMDB = False
+
+_HAS_LMDB = lmdb is not None
+
+
+def _open_env(path):
+    return lmdb.open(
+        path,
+        subdir=False,
+        readonly=True,
+        lock=False,
+        readahead=False,
+        meminit=False,
+        max_readers=256,
+    )
 
 
 class LMDBDataset(UnicoreDataset):
     def __init__(self, db_path):
-        if not _HAS_LMDB:
+        if lmdb is None:
             raise ImportError(
-                "LMDBDataset requires the 'lmdb' package; alternatively convert "
-                "your data with unicore_tpu.data.indexed_dataset.make_builder()."
+                "LMDBDataset requires the 'lmdb' package; alternatively "
+                "convert your data with "
+                "unicore_tpu.data.indexed_dataset.make_builder()."
             )
+        if not os.path.isfile(db_path):
+            raise AssertionError(f"{db_path} not found")
         self.db_path = db_path
-        assert os.path.isfile(db_path), f"{db_path} not found"
-        env = self.connect_db(self.db_path)
-        with env.begin() as txn:
-            self._keys = list(txn.cursor().iternext(values=False))
-        env.close()
+        # scan keys once with a throwaway env; the per-worker env opens on
+        # first read
+        env = _open_env(db_path)
+        try:
+            with env.begin() as txn:
+                self._keys = list(txn.cursor().iternext(values=False))
+        finally:
+            env.close()
         self._env = None
 
     def connect_db(self, lmdb_path, save_to_self=False):
-        env = lmdb.open(
-            lmdb_path,
-            subdir=False,
-            readonly=True,
-            lock=False,
-            readahead=False,
-            meminit=False,
-            max_readers=256,
-        )
-        if not save_to_self:
-            return env
-        else:
+        env = _open_env(lmdb_path)
+        if save_to_self:
             self._env = env
+        else:
+            return env
 
     def __len__(self):
         return len(self._keys)
 
     def __getitem__(self, idx):
-        # lazy open per worker process/thread
         if self._env is None:
             self.connect_db(self.db_path, save_to_self=True)
-        datapoint_pickled = self._env.begin().get(self._keys[idx])
-        return pickle.loads(datapoint_pickled)
+        raw = self._env.begin().get(self._keys[idx])
+        return pickle.loads(raw)

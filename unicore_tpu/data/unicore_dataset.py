@@ -1,30 +1,37 @@
-"""Dataset protocol (reference /root/reference/unicore/data/unicore_dataset.py:14-91).
+"""Dataset protocol.
 
-Map-style dataset yielding numpy samples; no torch dependency — the iterator
-layer collates on host and the trainer shards onto the device mesh.
+Parity surface (reference
+/root/reference/unicore/data/unicore_dataset.py:14-91): map-style datasets
+with collation, size queries for batching, epoch awareness and optional
+prefetch.  No torch dependency — samples are numpy; the iterator layer
+collates on host and the trainer lays batches onto the device mesh.
 """
 
 import numpy as np
 
 
 class EpochListening:
-    """Mixin for receiving updates whenever the epoch increments."""
+    """Mixin: receive the epoch number as epochs begin."""
+
+    def set_epoch(self, epoch):
+        """Called with the new (1-based) epoch before iteration starts."""
+        pass
 
     @property
     def can_reuse_epoch_itr_across_epochs(self):
-        """Whether one EpochBatchIterator can be reused for future epochs.
-
-        Only safe when the dataset is not epoch-aware (no epoch-seeded
-        masking/shuffling)."""
+        """True when epoch numbers don't change what the dataset yields
+        (no epoch-seeded masking/shuffling), letting the batch iterator be
+        reused instead of rebuilt."""
         return True
-
-    def set_epoch(self, epoch):
-        """Will receive the updated epoch number at the beginning of the epoch."""
-        pass
 
 
 class UnicoreDataset(EpochListening):
-    """A dataset that provides helpers for batching."""
+    """Map-style dataset with batching helpers.
+
+    Required: ``__getitem__``, ``__len__``, ``collater``.  Size queries
+    (``num_tokens`` / ``size``) only matter for length-aware batching;
+    ``ordered_indices`` defaults to natural order.
+    """
 
     def __getitem__(self, index):
         raise NotImplementedError
@@ -33,30 +40,41 @@ class UnicoreDataset(EpochListening):
         raise NotImplementedError
 
     def collater(self, samples):
-        """Merge a list of samples to form a mini-batch (numpy arrays)."""
+        """Merge a list of samples into a numpy mini-batch."""
         raise NotImplementedError
 
     def num_tokens(self, index: int):
-        """Return the number of tokens in a sample; used for max-tokens batching."""
+        """Token count of one sample, for max-tokens batching."""
         raise NotImplementedError
 
     def size(self, index: int):
-        """Return an example's size, used for filtering by max-positions."""
+        """Sample size used for max-positions filtering."""
         raise NotImplementedError
 
     def ordered_indices(self):
-        """Return an ordered list of indices; batches are constructed from it."""
+        """Index order batches are built from (natural order by default)."""
         return np.arange(len(self), dtype=np.int64)
+
+    def attr(self, attr: str, index: int):
+        """Per-index attribute lookup; the default ignores the index."""
+        return getattr(self, attr, None)
+
+    # -- optional prefetch support ------------------------------------------
 
     @property
     def supports_prefetch(self):
         return False
 
-    def attr(self, attr: str, index: int):
-        return getattr(self, attr, None)
-
     def prefetch(self, indices):
         raise NotImplementedError
+
+    @property
+    def supports_fetch_outside_dataloader(self):
+        """Whether items may be read directly (e.g. for the trainer's dummy
+        batch) rather than only through loader workers."""
+        return True
+
+    # -- batching ------------------------------------------------------------
 
     def batch_by_size(
         self,
@@ -64,6 +82,8 @@ class UnicoreDataset(EpochListening):
         batch_size=None,
         required_batch_size_multiple=1,
     ):
+        """Chunk ``indices`` into batches of ``batch_size``, respecting the
+        size multiple (see data_utils.batch_by_size)."""
         from unicore_tpu.data import data_utils
 
         return data_utils.batch_by_size(
@@ -71,7 +91,3 @@ class UnicoreDataset(EpochListening):
             batch_size=batch_size,
             required_batch_size_multiple=required_batch_size_multiple,
         )
-
-    @property
-    def supports_fetch_outside_dataloader(self):
-        return True

@@ -190,6 +190,20 @@ def all_reduce_dict(data: Dict[str, Any], device=None, group=None) -> Dict[str, 
     return {k: out[i] for i, k in enumerate(keys)}
 
 
+def _as_bytes(arr):
+    """Flat uint8 view of an array's buffer — the only dtype
+    ``multihost_utils`` moves losslessly under the default x64-disabled
+    config (int64/float64 payloads would be silently canonicalized to
+    32-bit; same workaround as broadcast_object's length header)."""
+    return np.frombuffer(np.ascontiguousarray(arr).tobytes(), dtype=np.uint8)
+
+
+def _from_bytes(buf, shape, dtype):
+    return np.frombuffer(
+        np.asarray(buf, dtype=np.uint8).tobytes(), dtype=dtype
+    ).reshape(shape)
+
+
 def all_to_all(tensor, group=None):
     """Host-level all-to-all: row block i of this host's array is delivered
     to host i; the result holds one row block from every host
@@ -201,11 +215,11 @@ def all_to_all(tensor, group=None):
     (or ``lax.all_to_all`` inside shard_map); this helper covers host-side
     control-plane use only.
     """
+    arr = np.asarray(tensor)
     if jax.process_count() == 1:
-        return np.asarray(tensor)
+        return arr
     from jax.experimental import multihost_utils
 
-    arr = np.asarray(tensor)
     n = jax.process_count()
     if arr.shape[0] % n != 0:
         raise ValueError(
@@ -214,9 +228,15 @@ def all_to_all(tensor, group=None):
         )
     rows = arr.shape[0] // n
     me = jax.process_index()
-    gathered = multihost_utils.process_allgather(arr)  # (n, rows*n, ...)
+    gathered = multihost_utils.process_allgather(_as_bytes(arr))  # (n, bytes)
     return np.concatenate(
-        [gathered[src, me * rows : (me + 1) * rows] for src in range(n)], axis=0
+        [
+            _from_bytes(gathered[src], arr.shape, arr.dtype)[
+                me * rows : (me + 1) * rows
+            ]
+            for src in range(n)
+        ],
+        axis=0,
     )
 
 
@@ -230,23 +250,24 @@ def broadcast_tensors(tensors, src_rank=0, group=None, dist_device=None):
 
     is_source = jax.process_index() == src_rank
     meta = (
-        [(tuple(t.shape), np.dtype(t.dtype).name) for t in tensors]
+        [
+            (tuple(np.asarray(t).shape), np.dtype(np.asarray(t).dtype).name)
+            for t in tensors
+        ]
         if is_source
         else None
     )
     meta = broadcast_object(meta, src_rank=src_rank)
     out = []
     for i, (shape, dtype) in enumerate(meta):
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
         buf = (
-            np.ascontiguousarray(np.asarray(tensors[i]))
+            _as_bytes(np.asarray(tensors[i]))
             if is_source
-            else np.zeros(shape, dtype=dtype)
+            else np.zeros((nbytes,), dtype=np.uint8)
         )
-        out.append(
-            np.asarray(
-                multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
-            )
-        )
+        got = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+        out.append(_from_bytes(got, shape, dtype))
     return out
 
 

@@ -1,9 +1,13 @@
-"""Global metrics aggregation with nested named contexts
-(reference /root/reference/unicore/logging/metrics.py).
+"""Global metrics aggregation with nested named contexts.
 
-Values logged from the training loop may be jax scalars; they are coerced to
-host floats lazily (at smoothed-value read time) so logging never forces a
-device sync in the hot loop.
+Parity surface (reference /root/reference/unicore/logging/metrics.py): the
+``aggregate(name)`` context manager (nestable; ``new_root`` isolates, used
+by validation inside the train loop), the ``log_*`` family, per-aggregator
+reads, and a checkpointable state_dict.  Implementation original to this
+framework: one module-level ``_State`` object owns the aggregator tables,
+and values logged from the training loop may be jax scalars — they are
+coerced to host floats lazily (at smoothed-value read time) so logging never
+forces a device sync in the hot loop.
 """
 
 import contextlib
@@ -19,87 +23,99 @@ from .meters import (
     TimeMeter,
 )
 
-# Aggregation contexts are considered "active" when inside the scope created
-# by the :func:`aggregate` context manager.
-_aggregators = dict()
-_active_aggregators = dict()
-_active_aggregators_cnt = defaultdict(lambda: 0)
+
+class _State:
+    """Aggregator tables: everything ever named, plus the currently-active
+    set (with a refcount so re-entrant ``aggregate`` nests cleanly)."""
+
+    def __init__(self):
+        self.clear()
+
+    def clear(self):
+        self.by_name = {}
+        self.active = {}
+        self.active_refs = defaultdict(int)
+        # the default aggregator observes every logged value
+        default = MetersDict()
+        self.by_name["default"] = default
+        self.active["default"] = default
+        self.active_refs["default"] = 1
+
+    def enter(self, name, agg):
+        self.active[name] = agg
+        self.active_refs[name] += 1
+
+    def leave(self, name):
+        self.active_refs[name] -= 1
+        if self.active_refs[name] == 0:
+            self.active.pop(name, None)
+
+    def snapshot(self):
+        return dict(self.active), dict(self.active_refs)
+
+    def restore(self, snap):
+        active, refs = snap
+        self.active = dict(active)
+        self.active_refs = defaultdict(int, refs)
+
+
+_state = _State()
 
 
 def reset() -> None:
-    """Reset all metrics aggregators."""
-    _aggregators.clear()
-    _active_aggregators.clear()
-    _active_aggregators_cnt.clear()
-
-    # The "default" aggregator observes all logged values.
-    _aggregators["default"] = MetersDict()
-    _active_aggregators["default"] = _aggregators["default"]
-    _active_aggregators_cnt["default"] = 1
-
-
-reset()
+    """Drop every aggregator and start fresh."""
+    _state.clear()
 
 
 @contextlib.contextmanager
 def aggregate(name: Optional[str] = None, new_root: bool = False):
-    """Context manager to aggregate metrics under a given name
-    (reference metrics.py:45-105).
-
-    Aggregations can be nested; ``new_root`` isolates from parent aggregators
-    (used by validation inside the train loop).
-    """
+    """Route logged values into the named aggregator for the duration of
+    the block (in addition to any other active aggregators — unless
+    ``new_root``, which suspends them)."""
     if name is None:
-        # generate a temporary name
-        name = str(uuid.uuid4())
-        assert name not in _aggregators
+        name = str(uuid.uuid4())  # anonymous, garbage-collected with scope
+        assert name not in _state.by_name
         agg = MetersDict()
     else:
         assert name != "default"
-        agg = _aggregators.setdefault(name, MetersDict())
+        agg = _state.by_name.setdefault(name, MetersDict())
 
+    snap = _state.snapshot() if new_root else None
     if new_root:
-        backup_aggregators = _active_aggregators.copy()
-        _active_aggregators.clear()
-        backup_aggregators_cnt = _active_aggregators_cnt.copy()
-        _active_aggregators_cnt.clear()
-
-    _active_aggregators[name] = agg
-    _active_aggregators_cnt[name] += 1
-
-    yield agg
-
-    _active_aggregators_cnt[name] -= 1
-    if _active_aggregators_cnt[name] == 0 and name in _active_aggregators:
-        del _active_aggregators[name]
-
-    if new_root:
-        _active_aggregators.clear()
-        _active_aggregators.update(backup_aggregators)
-        _active_aggregators_cnt.clear()
-        _active_aggregators_cnt.update(backup_aggregators_cnt)
+        _state.active = {}
+        _state.active_refs = defaultdict(int)
+    _state.enter(name, agg)
+    try:
+        yield agg
+    finally:
+        _state.leave(name)
+        if snap is not None:
+            _state.restore(snap)
 
 
 def get_active_aggregators() -> List[MetersDict]:
-    return list(_active_aggregators.values())
+    return list(_state.active.values())
+
+
+def _meter(key, priority, factory):
+    """Yield (aggregator, meter) for every active aggregator, creating the
+    meter on first sight."""
+    for agg in get_active_aggregators():
+        if key not in agg:
+            agg.add_meter(key, factory(), priority)
+        yield agg, agg[key]
 
 
 def log_scalar(key: str, value: float, weight: float = 1, priority: int = 10,
                round: Optional[int] = None):
-    """Log a scalar value (reference metrics.py:112).
-
-    Device scalars are accumulated as-is (jnp adds stay async-dispatched) and
-    only pulled to host when a smoothed value is displayed or checkpointed —
-    logging in the hot loop never blocks on the device.
-    """
-    for agg in get_active_aggregators():
-        if key not in agg:
-            agg.add_meter(key, AverageMeter(round=round), priority)
-        agg[key].update(value, weight)
+    """Weighted scalar.  Device scalars accumulate as-is (jnp adds stay
+    async-dispatched) and only reach the host at display/serialize time."""
+    for _, meter in _meter(key, priority, lambda: AverageMeter(round=round)):
+        meter.update(value, weight)
 
 
 def log_derived(key: str, fn: Callable[[MetersDict], float], priority: int = 20):
-    """Log a scalar value derived from other meters."""
+    """A value computed from the other meters at read time."""
     for agg in get_active_aggregators():
         if key not in agg:
             agg.add_meter(key, MetersDict._DerivedMeter(fn), priority)
@@ -107,25 +123,23 @@ def log_derived(key: str, fn: Callable[[MetersDict], float], priority: int = 20)
 
 def log_speed(key: str, value: float, priority: int = 30,
               round: Optional[int] = None):
-    """Log the rate of some quantity per second."""
+    """Rate of a quantity per second of wall time."""
     for agg in get_active_aggregators():
         if key not in agg:
             agg.add_meter(key, TimeMeter(round=round), priority)
-            agg[key].reset()  # reset meter on the first call
+            agg[key].reset()  # first sighting: anchor the clock, drop value
         else:
             agg[key].update(value)
 
 
 def log_start_time(key: str, priority: int = 40, round: Optional[int] = None):
-    """Log the duration of some event in seconds (start timer)."""
-    for agg in get_active_aggregators():
-        if key not in agg:
-            agg.add_meter(key, StopwatchMeter(round=round), priority)
-        agg[key].start()
+    """Open a stopwatch interval."""
+    for _, meter in _meter(key, priority, lambda: StopwatchMeter(round=round)):
+        meter.start()
 
 
 def log_stop_time(key: str, weight: float = 0.0, prehook=None):
-    """Log the duration of some event in seconds (stop timer)."""
+    """Close a stopwatch interval."""
     for agg in get_active_aggregators():
         if key in agg:
             agg[key].stop(weight, prehook)
@@ -133,50 +147,46 @@ def log_stop_time(key: str, weight: float = 0.0, prehook=None):
 
 def log_custom(new_meter_fn: Callable[[], Meter], key: str, *args,
                priority: int = 50, **kwargs):
-    """Log using a custom Meter."""
-    for agg in get_active_aggregators():
-        if key not in agg:
-            agg.add_meter(key, new_meter_fn(), priority)
-        agg[key].update(*args, **kwargs)
+    """Log through a caller-supplied meter type."""
+    for _, meter in _meter(key, priority, new_meter_fn):
+        meter.update(*args, **kwargs)
 
 
 def reset_meter(name: str, key: str) -> None:
-    """Reset a specific Meter."""
     meter = get_meter(name, key)
     if meter is not None:
         meter.reset()
 
 
 def reset_meters(name: str) -> None:
-    """Reset Meters in a given aggregator."""
     meters = get_meters(name)
     if meters is not None:
         meters.reset()
 
 
 def get_meter(name: str, key: str) -> Meter:
-    if name not in _aggregators:
-        return None
-    return _aggregators[name].get(key, None)
+    agg = _state.by_name.get(name)
+    return agg.get(key, None) if agg is not None else None
 
 
 def get_meters(name: str) -> MetersDict:
-    return _aggregators.get(name, None)
+    return _state.by_name.get(name, None)
 
 
 def get_smoothed_value(name: str, key: str) -> float:
-    return _aggregators[name].get_smoothed_value(key)
+    return _state.by_name[name].get_smoothed_value(key)
 
 
 def get_smoothed_values(name: str):
-    return _aggregators[name].get_smoothed_values()
+    return _state.by_name[name].get_smoothed_values()
 
 
 def state_dict():
-    return {name: agg.state_dict() for name, agg in _aggregators.items()}
+    return {name: agg.state_dict() for name, agg in _state.by_name.items()}
 
 
-def load_state_dict(state_dict):
-    for name, agg_state in state_dict.items():
-        _aggregators[name] = MetersDict()
-        _aggregators[name].load_state_dict(agg_state)
+def load_state_dict(state):
+    for name, agg_state in state.items():
+        agg = MetersDict()
+        agg.load_state_dict(agg_state)
+        _state.by_name[name] = agg

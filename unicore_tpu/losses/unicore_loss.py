@@ -25,27 +25,26 @@ class UnicoreLoss:
 
     @classmethod
     def build_loss(cls, args, task):
-        """Construct a loss, reflection-matching ``__init__`` params against
-        args (reference unicore_loss.py:29-57)."""
-        init_args = {}
+        """Construct a loss, matching ``__init__`` parameters against args
+        by name (same construction contract as the reference,
+        unicore_loss.py:29-57): ``task`` is injected, other parameters pull
+        the like-named args attribute, falling back to their declared
+        default."""
+        kwargs = {}
         for p in inspect.signature(cls).parameters.values():
-            if (
-                p.kind == p.POSITIONAL_ONLY
-                or p.kind == p.VAR_POSITIONAL
-                or p.kind == p.VAR_KEYWORD
-            ):
-                raise NotImplementedError("losses must take explicit keyword arguments")
+            if p.kind in (p.POSITIONAL_ONLY, p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                raise NotImplementedError(
+                    "losses must take explicit keyword arguments"
+                )
             if p.name == "task":
-                init_args["task"] = task
+                kwargs["task"] = task
             elif hasattr(args, p.name):
-                init_args[p.name] = getattr(args, p.name)
-            elif p.default != p.empty:
-                pass  # we'll use the default value
-            else:
+                kwargs[p.name] = getattr(args, p.name)
+            elif p.default is p.empty:
                 raise NotImplementedError(
                     f"Unable to infer loss argument: {p.name}"
                 )
-        return cls(**init_args)
+        return cls(**kwargs)
 
     def forward(
         self, model, params, sample, rngs=None, train=True

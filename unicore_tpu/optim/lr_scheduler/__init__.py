@@ -1,11 +1,20 @@
-"""LR scheduler registry
-(reference /root/reference/unicore/optim/lr_scheduler/__init__.py:17-27)."""
+"""LR scheduler registry and auto-discovery.
+
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/__init__.py:17-27): the
+``--lr-scheduler`` choice flag with ``fixed`` as default; schedule modules
+in this package self-register on import.
+"""
 
 import importlib
-import os
+import pkgutil
 
 from unicore_tpu import registry
-from .unicore_lr_scheduler import UnicoreLRScheduler  # noqa
+from .unicore_lr_scheduler import (  # noqa
+    UnicoreLRScheduler,
+    linear_warmup,
+    single_lr,
+)
 
 (
     build_lr_scheduler_,
@@ -20,9 +29,7 @@ def build_lr_scheduler(args, optimizer, total_train_steps):
     return build_lr_scheduler_(args, optimizer, total_train_steps)
 
 
-# automatically import any Python files in this directory
-for file in sorted(os.listdir(os.path.dirname(__file__))):
-    if file.endswith(".py") and not file.startswith("_") and file != "unicore_lr_scheduler.py":
-        importlib.import_module(
-            "unicore_tpu.optim.lr_scheduler." + file[: file.find(".py")]
-        )
+# import every schedule module in this package so its @register decorator runs
+for _mod in pkgutil.iter_modules(__path__):
+    if not _mod.name.startswith("_") and _mod.name != "unicore_lr_scheduler":
+        importlib.import_module(f"{__name__}.{_mod.name}")

@@ -1,102 +1,110 @@
-"""Cosine (SGDR-style) schedule
-(reference /root/reference/unicore/optim/lr_scheduler/cosine_lr_scheduler.py:14)."""
+"""Cosine annealing with warm restarts (SGDR) and linear warmup.
+
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/cosine_lr_scheduler.py:14):
+period growth via ``--t-mult``, per-restart shrink via ``--lr-shrink``,
+warmup by count or ratio.  Implementation original to this framework.
+"""
 
 import math
-from collections.abc import Collection
 
-from . import UnicoreLRScheduler, register_lr_scheduler
+from . import UnicoreLRScheduler, linear_warmup, register_lr_scheduler, single_lr
+
+
+def cosine_lr(num_updates, *, warmup_updates, warmup_init_lr, min_lr, max_lr,
+              period, t_mult, lr_shrink):
+    """lr after warmup: cosine within the current restart period.
+
+    With ``t_mult != 1`` period i has length ``t_mult^i * period``; each
+    restart shrinks both ends of the range by ``lr_shrink``.
+    """
+    if num_updates < warmup_updates:
+        return linear_warmup(num_updates, warmup_updates, warmup_init_lr, max_lr)
+    t = num_updates - warmup_updates
+    if t_mult != 1:
+        # which restart period t falls in, and the offset into it
+        i = math.floor(math.log(1 - t / period * (1 - t_mult), t_mult))
+        length = t_mult ** i * period
+        start = (1 - t_mult ** i) / (1 - t_mult) * period
+        frac = (t - start) / length
+    else:
+        i = 0
+        frac = min(1.0, t / period)
+    shrink = lr_shrink ** i
+    lo, hi = min_lr * shrink, max_lr * shrink
+    return lo + 0.5 * (hi - lo) * (1 + math.cos(math.pi * frac))
 
 
 @register_lr_scheduler("cosine")
 class CosineLRSchedule(UnicoreLRScheduler):
     def __init__(self, args, unicore_optimizer, total_train_steps):
         super().__init__(args, unicore_optimizer, total_train_steps)
-        if isinstance(args.lr, Collection) and len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with cosine."
-                f" Consider --lr-scheduler=fixed instead. ({args.lr})"
-            )
-
-        self.max_lr = args.lr[0] if isinstance(args.lr, Collection) else args.lr
-        assert (
-            self.max_lr > args.min_lr
-        ), f"max_lr (={args.lr}) must be more than min_lr (={args.min_lr})"
-
+        self.max_lr = single_lr(args, "cosine")
+        assert self.max_lr > args.min_lr, (
+            f"max_lr (={args.lr}) must be more than min_lr (={args.min_lr})"
+        )
         assert total_train_steps is not None
-        if self.args.warmup_ratio > 0:
-            self.warmup_updates = int(self.args.warmup_ratio * total_train_steps)
+        if args.warmup_ratio > 0:
+            self.warmup_updates = int(args.warmup_ratio * total_train_steps)
         else:
             self.warmup_updates = args.warmup_updates
-
-        warmup_end_lr = self.max_lr
         if args.warmup_init_lr < 0:
             args.warmup_init_lr = args.min_lr
-
-        self.t_mult = args.t_mult
         self.period = args.lr_period_updates
         if self.period <= 0:
             self.period = total_train_steps - self.warmup_updates
-
-        if self.warmup_updates > 0:
-            self.lr_step = (warmup_end_lr - args.warmup_init_lr) / self.warmup_updates
-        else:
-            self.lr_step = 1
-
-        self.lr_shrink = args.lr_shrink
-        self.lr = args.warmup_init_lr
-        self.set_lr(self.lr)
+        self.set_lr(args.warmup_init_lr)
 
     @staticmethod
     def add_args(parser):
-        parser.add_argument('--warmup-updates', default=0, type=int, metavar='N',
-                            help='warmup the learning rate linearly for the first N updates')
-        parser.add_argument('--warmup-ratio', default=-1.0, type=float, metavar='N',
-                            help='warmup the learning rate linearly for the first N-percent updates')
-        parser.add_argument('--warmup-init-lr', default=-1, type=float, metavar='LR',
-                            help='initial learning rate during warmup phase; default is args.lr')
-        parser.add_argument('--min-lr', type=float, metavar='LR', default=0.0,
-                            help='min learning rate')
-        parser.add_argument('--max-lr', type=float, metavar='LR',
-                            help='max learning rate, must be more than args.lr')
-        parser.add_argument('--t-mult', default=1, type=float, metavar='LR',
-                            help='factor to grow the length of each period')
-        parser.add_argument('--lr-period-updates', default=-1, type=float, metavar='LR',
-                            help='initial number of updates per period')
-        parser.add_argument('--lr-shrink', default=0.1, type=float, metavar='LS',
-                            help='shrink factor for annealing')
+        parser.add_argument(
+            "--warmup-updates", default=0, type=int, metavar="N",
+            help="warmup the learning rate linearly for the first N updates",
+        )
+        parser.add_argument(
+            "--warmup-ratio", default=-1.0, type=float, metavar="N",
+            help="warmup the learning rate linearly for the first N-percent updates",
+        )
+        parser.add_argument(
+            "--warmup-init-lr", default=-1, type=float, metavar="LR",
+            help="initial learning rate during warmup phase; default is args.lr",
+        )
+        parser.add_argument(
+            "--min-lr", type=float, metavar="LR", default=0.0,
+            help="min learning rate",
+        )
+        parser.add_argument(
+            "--max-lr", type=float, metavar="LR",
+            help="max learning rate, must be more than args.lr",
+        )
+        parser.add_argument(
+            "--t-mult", default=1, type=float, metavar="LR",
+            help="factor to grow the length of each period",
+        )
+        parser.add_argument(
+            "--lr-period-updates", default=-1, type=float, metavar="LR",
+            help="initial number of updates per period",
+        )
+        parser.add_argument(
+            "--lr-shrink", default=0.1, type=float, metavar="LS",
+            help="shrink factor for annealing",
+        )
 
     def step(self, epoch, val_loss=None):
         super().step(epoch, val_loss)
         return self.get_lr()
 
     def step_update(self, num_updates):
-        if num_updates < self.warmup_updates:
-            self.lr = self.args.warmup_init_lr + num_updates * self.lr_step
-        else:
-            curr_updates = num_updates - self.warmup_updates
-            if self.t_mult != 1:
-                i = math.floor(
-                    math.log(
-                        1 - curr_updates / self.period * (1 - self.t_mult), self.t_mult
-                    )
-                )
-                t_i = self.t_mult ** i * self.period
-                t_curr = (
-                    curr_updates
-                    - (1 - self.t_mult ** i) / (1 - self.t_mult) * self.period
-                )
-                r = float(t_curr) / t_i
-            else:
-                i = 0
-                t_i = self.period
-                t_curr = curr_updates
-                r = min(1.0, float(t_curr) / t_i)
-
-            lr_shrink = self.lr_shrink ** i
-            min_lr = self.args.min_lr * lr_shrink
-            max_lr = self.max_lr * lr_shrink
-
-            self.lr = min_lr + 0.5 * (max_lr - min_lr) * (1 + math.cos(math.pi * r))
-
-        self.set_lr(self.lr)
-        return self.lr
+        self.set_lr(
+            cosine_lr(
+                num_updates,
+                warmup_updates=self.warmup_updates,
+                warmup_init_lr=self.args.warmup_init_lr,
+                min_lr=self.args.min_lr,
+                max_lr=self.max_lr,
+                period=self.period,
+                t_mult=self.args.t_mult,
+                lr_shrink=self.args.lr_shrink,
+            )
+        )
+        return self.get_lr()

@@ -1,46 +1,59 @@
-"""Inverse-sqrt schedule
-(reference /root/reference/unicore/optim/lr_scheduler/inverse_square_root_schedule.py:13)."""
+"""Inverse-square-root decay with linear warmup (the Transformer schedule).
 
-from collections.abc import Collection
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/inverse_square_root_schedule.py:13).
+Implementation original to this framework: the lr is one pure function of
+the update count.
+"""
 
-from . import UnicoreLRScheduler, register_lr_scheduler
+from . import UnicoreLRScheduler, linear_warmup, register_lr_scheduler, single_lr
+
+
+def inverse_sqrt_lr(num_updates, warmup_updates, warmup_init_lr, peak_lr):
+    """Linear ramp to ``peak_lr`` over the warmup, then decay proportional
+    to 1/sqrt(update) — continuous at the boundary."""
+    if num_updates < warmup_updates:
+        return linear_warmup(num_updates, warmup_updates, warmup_init_lr, peak_lr)
+    return peak_lr * (warmup_updates ** 0.5) * num_updates ** -0.5
 
 
 @register_lr_scheduler("inverse_sqrt")
 class InverseSquareRootSchedule(UnicoreLRScheduler):
     def __init__(self, args, optimizer, total_train_steps):
         super().__init__(args, optimizer, total_train_steps)
-        if isinstance(args.lr, Collection) and len(args.lr) > 1:
+        if args.warmup_updates <= 0:
+            # the decay term is peak * sqrt(warmup/t): warmup 0 would mean
+            # a permanent lr of 0 — reject loudly
             raise ValueError(
-                "Cannot use a fixed learning rate schedule with inverse_sqrt."
-                " Consider --lr-scheduler=fixed instead."
+                "inverse_sqrt requires --warmup-updates > 0"
             )
-        warmup_end_lr = args.lr[0] if isinstance(args.lr, Collection) else args.lr
+        self.peak_lr = single_lr(args, "inverse_sqrt")
         if args.warmup_init_lr < 0:
-            args.warmup_init_lr = 0 if args.warmup_updates > 0 else warmup_end_lr
-
-        # linearly warmup for the first args.warmup_updates
-        self.lr_step = (warmup_end_lr - args.warmup_init_lr) / args.warmup_updates
-        # then, decay prop. to the inverse square root of the update number
-        self.decay_factor = warmup_end_lr * args.warmup_updates ** 0.5
-        self.lr = args.warmup_init_lr
-        self.set_lr(self.lr)
+            args.warmup_init_lr = 0 if args.warmup_updates > 0 else self.peak_lr
+        self.set_lr(args.warmup_init_lr)
 
     @staticmethod
     def add_args(parser):
-        parser.add_argument('--warmup-updates', default=4000, type=int, metavar='N',
-                            help='warmup the learning rate linearly for the first N updates')
-        parser.add_argument('--warmup-init-lr', default=-1, type=float, metavar='LR',
-                            help='initial learning rate during warmup phase; default is args.lr')
+        parser.add_argument(
+            "--warmup-updates", default=4000, type=int, metavar="N",
+            help="warmup the learning rate linearly for the first N updates",
+        )
+        parser.add_argument(
+            "--warmup-init-lr", default=-1, type=float, metavar="LR",
+            help="initial learning rate during warmup phase; default is args.lr",
+        )
 
     def step(self, epoch, val_loss=None):
         super().step(epoch, val_loss)
         return self.get_lr()
 
     def step_update(self, num_updates):
-        if num_updates < self.args.warmup_updates:
-            self.lr = self.args.warmup_init_lr + num_updates * self.lr_step
-        else:
-            self.lr = self.decay_factor * num_updates ** -0.5
-        self.set_lr(self.lr)
-        return self.lr
+        self.set_lr(
+            inverse_sqrt_lr(
+                num_updates,
+                self.args.warmup_updates,
+                self.args.warmup_init_lr,
+                self.peak_lr,
+            )
+        )
+        return self.get_lr()

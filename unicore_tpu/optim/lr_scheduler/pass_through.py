@@ -1,5 +1,9 @@
-"""Pass-through schedule — delegate lr scheduling to the optimizer
-(reference /root/reference/unicore/optim/lr_scheduler/pass_through.py:10)."""
+"""Delegating schedule for optimizers that bring their own scheduler.
+
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/pass_through.py:10): every hook
+forwards to ``optimizer.lr_scheduler``.
+"""
 
 from . import UnicoreLRScheduler, register_lr_scheduler
 
@@ -8,18 +12,21 @@ from . import UnicoreLRScheduler, register_lr_scheduler
 class PassThroughScheduleSchedule(UnicoreLRScheduler):
     def __init__(self, args, optimizer, total_train_steps):
         super().__init__(args, optimizer, total_train_steps)
-        assert (
-            hasattr(optimizer, "lr_scheduler") and optimizer.lr_scheduler is not None
-        ), "Pass-through schedule can only be used with optimizers with their own schedulers"
+        if getattr(optimizer, "lr_scheduler", None) is None:
+            raise AssertionError(
+                "Pass-through schedule can only be used with optimizers "
+                "with their own schedulers"
+            )
+        self._inner = optimizer.lr_scheduler
 
     def state_dict(self):
-        return self.optimizer.lr_scheduler.state_dict()
+        return self._inner.state_dict()
 
     def load_state_dict(self, state_dict):
-        self.optimizer.lr_scheduler.load_state_dict(state_dict)
+        self._inner.load_state_dict(state_dict)
 
     def step_begin_epoch(self, epoch):
-        return self.optimizer.lr_scheduler.step_begin_epoch(epoch)
+        return self._inner.step_begin_epoch(epoch)
 
     def step_update(self, num_updates):
-        return self.optimizer.lr_scheduler.step_update(num_updates)
+        return self._inner.step_update(num_updates)

@@ -1,92 +1,100 @@
-"""Tri-stage (warmup / hold / exponential-decay) schedule
-(reference /root/reference/unicore/optim/lr_scheduler/tri_stage_lr_scheduler.py:13)."""
+"""Three-stage schedule: linear warmup, hold at peak, exponential decay.
+
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/tri_stage_lr_scheduler.py:13):
+stage lengths by explicit step counts or ``--phase-ratio`` of
+``--max-update``; past the decay stage the lr holds at the final value.
+Implementation original to this framework.
+"""
 
 import math
 
-from . import UnicoreLRScheduler, register_lr_scheduler
+from . import UnicoreLRScheduler, register_lr_scheduler, single_lr
+
+
+def tri_stage_lr(num_updates, *, init_lr, peak_lr, final_lr, warmup_steps,
+                 hold_steps, decay_steps, decay_factor):
+    if num_updates < warmup_steps:
+        ramp = (peak_lr - init_lr) / warmup_steps if warmup_steps else 0
+        return init_lr + ramp * num_updates
+    t = num_updates - warmup_steps
+    if t < hold_steps:
+        return peak_lr
+    t -= hold_steps
+    if t <= decay_steps:
+        return peak_lr * math.exp(-decay_factor * t)
+    return final_lr
 
 
 @register_lr_scheduler("tri_stage")
 class TriStageLRSchedule(UnicoreLRScheduler):
     def __init__(self, args, optimizer, total_train_steps):
         super().__init__(args, optimizer, total_train_steps)
-        if len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with tri-stage lr."
-                " Consider --lr-scheduler=fixed instead."
-            )
-
-        self.peak_lr = args.lr[0]
-        self.init_lr = args.init_lr_scale * args.lr[0]
-        self.final_lr = args.final_lr_scale * args.lr[0]
+        peak = single_lr(args, "tri-stage lr")
+        self.peak_lr = peak
+        self.init_lr = args.init_lr_scale * peak
+        self.final_lr = args.final_lr_scale * peak
 
         if getattr(args, "phase_ratio", None) is not None:
             assert args.max_update > 0
             assert sum(args.phase_ratio) == 1, "phase ratios must add up to 1"
-            self.warmup_steps = int(args.max_update * args.phase_ratio[0])
-            self.hold_steps = int(args.max_update * args.phase_ratio[1])
-            self.decay_steps = int(args.max_update * args.phase_ratio[2])
+            ratios = args.phase_ratio
+            self.warmup_steps = int(args.max_update * ratios[0])
+            self.hold_steps = int(args.max_update * ratios[1])
+            self.decay_steps = int(args.max_update * ratios[2])
         else:
             self.warmup_steps = args.warmup_steps
             self.hold_steps = args.hold_steps
             self.decay_steps = args.decay_steps
-
-        assert (
-            self.warmup_steps + self.hold_steps + self.decay_steps > 0
-        ), "please specify steps or phase_ratio"
-
-        self.warmup_rate = (
-            (self.peak_lr - self.init_lr) / self.warmup_steps
-            if self.warmup_steps != 0
-            else 0
+        assert self.warmup_steps + self.hold_steps + self.decay_steps > 0, (
+            "please specify steps or phase_ratio"
         )
-        self.decay_factor = -math.log(args.final_lr_scale) / self.decay_steps
 
-        self.lr = self.init_lr
-        self.set_lr(self.lr)
+        self.decay_factor = -math.log(args.final_lr_scale) / self.decay_steps
+        self.set_lr(self.init_lr)
 
     @staticmethod
     def add_args(parser):
-        parser.add_argument('--warmup-steps', default=4000, type=int, metavar='N',
-                            help='warmup the learning rate linearly for the first N updates')
-        parser.add_argument('--hold-steps', default=20000, type=int, metavar='N',
-                            help='steps in hold stage')
-        parser.add_argument('--decay-steps', default=60000, type=int, metavar='N',
-                            help='steps in decay stages')
-        parser.add_argument('--init-lr-scale', default=0.01, type=float,
-                            help='initial learning rate scale during warmup phase')
-        parser.add_argument('--final-lr-scale', default=0.01, type=float,
-                            help='final learning rate scale')
-        parser.add_argument('--phase-ratio', default=None, type=eval,
-                            help='ratio for warmup/hold/decay phases (requires --max-update)')
-
-    def _decide_stage(self, update_step):
-        if update_step < self.warmup_steps:
-            return 0, update_step
-        offset = self.warmup_steps
-        if update_step < offset + self.hold_steps:
-            return 1, update_step - offset
-        offset += self.hold_steps
-        if update_step <= offset + self.decay_steps:
-            return 2, update_step - offset
-        offset += self.decay_steps
-        return 3, update_step - offset
+        parser.add_argument(
+            "--warmup-steps", default=4000, type=int, metavar="N",
+            help="warmup the learning rate linearly for the first N updates",
+        )
+        parser.add_argument(
+            "--hold-steps", default=20000, type=int, metavar="N",
+            help="steps in hold stage",
+        )
+        parser.add_argument(
+            "--decay-steps", default=60000, type=int, metavar="N",
+            help="steps in decay stages",
+        )
+        parser.add_argument(
+            "--init-lr-scale", default=0.01, type=float,
+            help="initial learning rate scale during warmup phase",
+        )
+        parser.add_argument(
+            "--final-lr-scale", default=0.01, type=float,
+            help="final learning rate scale",
+        )
+        parser.add_argument(
+            "--phase-ratio", default=None, type=eval,
+            help="ratio for warmup/hold/decay phases (requires --max-update)",
+        )
 
     def step(self, epoch, val_loss=None):
         super().step(epoch, val_loss)
         return self.get_lr()
 
     def step_update(self, num_updates):
-        stage, steps_in_stage = self._decide_stage(num_updates)
-        if stage == 0:
-            self.lr = self.init_lr + self.warmup_rate * steps_in_stage
-        elif stage == 1:
-            self.lr = self.peak_lr
-        elif stage == 2:
-            self.lr = self.peak_lr * math.exp(-self.decay_factor * steps_in_stage)
-        elif stage == 3:
-            self.lr = self.final_lr
-        else:
-            raise ValueError("Undefined stage")
-        self.set_lr(self.lr)
-        return self.lr
+        self.set_lr(
+            tri_stage_lr(
+                num_updates,
+                init_lr=self.init_lr,
+                peak_lr=self.peak_lr,
+                final_lr=self.final_lr,
+                warmup_steps=self.warmup_steps,
+                hold_steps=self.hold_steps,
+                decay_steps=self.decay_steps,
+                decay_factor=self.decay_factor,
+            )
+        )
+        return self.get_lr()

@@ -1,57 +1,70 @@
-"""Triangular cyclical schedule
-(reference /root/reference/unicore/optim/lr_scheduler/triangular_lr_scheduler.py:13)."""
+"""Triangular cyclical lr (CLR), optionally shrinking per cycle.
+
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/triangular_lr_scheduler.py:13).
+Implementation original to this framework.
+"""
 
 import math
 
-from . import UnicoreLRScheduler, register_lr_scheduler
+from . import UnicoreLRScheduler, register_lr_scheduler, single_lr
+
+
+def triangular_lr(num_updates, *, min_lr, max_lr, stepsize, lr_shrink,
+                  shrink_min):
+    """Sawtooth between min and max with half-cycle ``stepsize`` updates;
+    every full cycle scales the peak (and optionally the floor) by
+    ``lr_shrink``."""
+    cycle = math.floor(num_updates / (2 * stepsize))
+    shrink = lr_shrink ** cycle
+    hi = max_lr * shrink
+    lo = min_lr * shrink if shrink_min else min_lr
+    # distance from the cycle's peak, normalized to [0, 1]
+    x = abs(num_updates / stepsize - 2 * (cycle + 1) + 1)
+    return lo + (hi - lo) * max(0, 1 - x)
 
 
 @register_lr_scheduler("triangular")
 class TriangularLRSchedule(UnicoreLRScheduler):
     def __init__(self, args, optimizer, total_train_steps):
         super().__init__(args, optimizer, total_train_steps)
-        if len(args.lr) > 1:
-            raise ValueError(
-                "Cannot use a fixed learning rate schedule with triangular."
-                " Consider --lr-scheduler=fixed instead."
-            )
-        lr = args.lr[0]
-        assert args.max_lr > lr, "max_lr must be more than lr"
-        self.min_lr = lr
-        self.max_lr = args.max_lr
+        self.min_lr = single_lr(args, "triangular")
+        assert args.max_lr > self.min_lr, "max_lr must be more than lr"
         self.stepsize = args.lr_period_updates // 2
-        self.lr_shrink = args.lr_shrink
-        self.shrink_min = args.shrink_min
-        self.lr = self.min_lr
-        self.set_lr(self.lr)
+        self.set_lr(self.min_lr)
 
     @staticmethod
     def add_args(parser):
-        parser.add_argument('--max-lr', required=True, type=float, metavar='LR',
-                            help='max learning rate, must be more than args.lr')
-        parser.add_argument('--lr-period-updates', default=5000, type=float, metavar='LR',
-                            help='initial number of updates per period (cycle length)')
-        parser.add_argument('--lr-shrink', default=0.1, type=float, metavar='LS',
-                            help='shrink factor for annealing')
-        parser.add_argument('--shrink-min', action='store_true',
-                            help='if set, also shrinks min lr')
+        parser.add_argument(
+            "--max-lr", required=True, type=float, metavar="LR",
+            help="max learning rate, must be more than args.lr",
+        )
+        parser.add_argument(
+            "--lr-period-updates", default=5000, type=float, metavar="LR",
+            help="initial number of updates per period (cycle length)",
+        )
+        parser.add_argument(
+            "--lr-shrink", default=0.1, type=float, metavar="LS",
+            help="shrink factor for annealing",
+        )
+        parser.add_argument(
+            "--shrink-min", action="store_true",
+            help="if set, also shrinks min lr",
+        )
 
     def step(self, epoch, val_loss=None):
         super().step(epoch, val_loss)
         return self.get_lr()
 
     def step_update(self, num_updates):
-        cycle = math.floor(num_updates / (2 * self.stepsize))
-
-        lr_shrink = self.lr_shrink ** cycle
-        max_lr = self.max_lr * lr_shrink
-        if self.shrink_min:
-            min_lr = self.min_lr * lr_shrink
-        else:
-            min_lr = self.min_lr
-
-        x = abs(num_updates / self.stepsize - 2 * (cycle + 1) + 1)
-        self.lr = min_lr + (max_lr - min_lr) * max(0, 1 - x)
-
-        self.set_lr(self.lr)
-        return self.lr
+        self.set_lr(
+            triangular_lr(
+                num_updates,
+                min_lr=self.min_lr,
+                max_lr=self.args.max_lr,
+                stepsize=self.stepsize,
+                lr_shrink=self.args.lr_shrink,
+                shrink_min=self.args.shrink_min,
+            )
+        )
+        return self.get_lr()

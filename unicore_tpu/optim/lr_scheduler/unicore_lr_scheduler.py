@@ -1,13 +1,18 @@
-"""LR scheduler base
-(reference /root/reference/unicore/optim/lr_scheduler/unicore_lr_scheduler.py:12-49).
+"""LR scheduler protocol.
 
-Schedulers run host-side: the trainer calls ``step_update(num_updates)`` each
-step and passes the returned float into the jitted train step as a traced
-scalar — cheap host math, no recompile, and plateau-style schedules that need
-validation losses work unchanged.
+Parity surface (reference
+/root/reference/unicore/optim/lr_scheduler/unicore_lr_scheduler.py:12-49):
+``step_begin_epoch`` / ``step`` (end of epoch, sees val_loss) /
+``step_update`` (per update, returns the lr) hooks plus state_dict resume.
+
+Design: schedulers run host-side and OWN the current lr — the functional
+optimizer takes lr as a step argument, so there are no optimizer
+set_lr/get_lr round-trips to mirror.  The trainer passes the returned float
+into the jitted step as a traced scalar: cheap host math, no recompile, and
+plateau-style schedules that need validation losses work unchanged.
+Concrete schedules express the lr as a pure function of the update count;
+the classes are thin stateful wrappers over those functions.
 """
-
-from argparse import Namespace
 
 
 class UnicoreLRScheduler(object):
@@ -17,15 +22,14 @@ class UnicoreLRScheduler(object):
         self.optimizer = optimizer
         self.total_train_steps = total_train_steps
         self.best = None
-        self._lr = args.lr[0] if isinstance(getattr(args, "lr", None), list) else getattr(args, "lr", 0.0)
+        lr_arg = getattr(args, "lr", 0.0)
+        self._lr = lr_arg[0] if isinstance(lr_arg, list) else lr_arg
 
     @classmethod
     def add_args(cls, parser):
-        """Add arguments to the parser for this LR scheduler."""
+        """Register this scheduler's CLI flags."""
         pass
 
-    # the functional optimizer takes lr as a step argument, so the scheduler
-    # itself is the lr owner (replaces optimizer.set_lr/get_lr round-trips)
     def set_lr(self, lr):
         self._lr = lr
 
@@ -41,17 +45,39 @@ class UnicoreLRScheduler(object):
             self._lr = state_dict["lr"]
 
     def step_begin_epoch(self, epoch):
-        """Update the learning rate at the beginning of the given epoch."""
+        """Hook: a new epoch is starting."""
         pass
 
     def step(self, epoch, val_loss=None):
-        """Update the learning rate at the end of the given epoch."""
+        """Hook: an epoch finished; tracks the best validation loss for
+        plateau-style schedules."""
         if val_loss is not None:
-            if self.best is None:
-                self.best = val_loss
-            else:
-                self.best = min(self.best, val_loss)
+            self.best = (
+                val_loss if self.best is None else min(self.best, val_loss)
+            )
 
     def step_update(self, num_updates):
-        """Update the learning rate after each update."""
+        """Hook: an optimizer update finished; returns the lr to use."""
         return self.get_lr()
+
+
+def linear_warmup(num_updates, warmup_updates, init_lr, end_lr):
+    """lr on the warmup ramp: init_lr at update 0 rising linearly to end_lr
+    at update ``warmup_updates``."""
+    if warmup_updates <= 0:
+        return end_lr
+    frac = min(num_updates, warmup_updates) / float(warmup_updates)
+    return init_lr + (end_lr - init_lr) * frac
+
+
+def single_lr(args, name):
+    """The schedule's base lr; rejects the fixed-schedule multi-lr list."""
+    lr = args.lr
+    if not isinstance(lr, (list, tuple)):
+        return lr
+    if len(lr) > 1:
+        raise ValueError(
+            f"Cannot use a fixed learning rate schedule with {name}."
+            f" Consider --lr-scheduler=fixed instead. ({lr})"
+        )
+    return lr[0]

@@ -1,11 +1,13 @@
 """Generic registry engine.
 
 Capability parity with the reference registry system
-(/root/reference/unicore/registry.py:13-81): each registry owns a ``--<name>``
-CLI choice flag, a decorator to register implementations, and a ``build_x``
-that injects the registered class's argparse defaults into the args namespace
-before construction.  Re-designed as a plain-Python component (no torch / no
-device deps) shared by optimizers, LR schedulers, losses, tasks and models.
+(/root/reference/unicore/registry.py:13-81): each registry owns a
+``--<name>`` CLI choice flag, a decorator to register implementations, and a
+builder that injects the chosen class's argparse defaults into the args
+namespace before construction.  Re-designed as a small ``Registry`` object
+(no torch / no device deps) shared by optimizers, LR schedulers, losses,
+tasks and models; ``setup_registry`` returns the classic
+(build, register, REGISTRY-dict) triple for call-site compatibility.
 """
 
 import argparse
@@ -13,68 +15,80 @@ import argparse
 REGISTRIES = {}
 
 
-def setup_registry(registry_name: str, base_class=None, default=None, required=False):
-    assert registry_name.startswith("--")
-    registry_name = registry_name[2:].replace("-", "_")
+class Registry:
+    def __init__(self, name: str, base_class=None, default=None):
+        self.name = name
+        self.base_class = base_class
+        self.default = default
+        self.classes = {}
+        self._class_names = set()
 
-    REGISTRY = {}
-    REGISTRY_CLASS_NAMES = set()
+    def register(self, key):
+        """Decorator: ``@register_x("key")`` adds the class under ``key``."""
 
-    # maintain a registry of all registries
-    if registry_name in REGISTRIES:
-        raise ValueError(f"Cannot setup duplicate registry: {registry_name}")
-    REGISTRIES[registry_name] = {"registry": REGISTRY, "default": default}
-
-    def build_x(args, *extra_args, **extra_kwargs):
-        choice = getattr(args, registry_name, None)
-        if choice is None:
-            return None
-        cls = REGISTRY[choice]
-        if hasattr(cls, "build_" + registry_name):
-            builder = getattr(cls, "build_" + registry_name)
-        else:
-            builder = cls
-        set_defaults(args, cls)
-        return builder(args, *extra_args, **extra_kwargs)
-
-    def register_x(name):
-        def register_x_cls(cls):
-            if name in REGISTRY:
+        def deco(cls):
+            if key in self.classes:
                 raise ValueError(
-                    f"Cannot register duplicate {registry_name} ({name})"
+                    f"Cannot register duplicate {self.name} ({key})"
                 )
-            if cls.__name__ in REGISTRY_CLASS_NAMES:
+            if cls.__name__ in self._class_names:
                 raise ValueError(
-                    f"Cannot register {registry_name} with duplicate class name "
+                    f"Cannot register {self.name} with duplicate class name "
                     f"({cls.__name__})"
                 )
-            if base_class is not None and not issubclass(cls, base_class):
+            if self.base_class is not None and not issubclass(
+                cls, self.base_class
+            ):
                 raise ValueError(
-                    f"{registry_name} must extend {base_class.__name__}"
+                    f"{self.name} must extend {self.base_class.__name__}"
                 )
-            REGISTRY[name] = cls
-            REGISTRY_CLASS_NAMES.add(cls.__name__)
+            self.classes[key] = cls
+            self._class_names.add(cls.__name__)
             return cls
 
-        return register_x_cls
+        return deco
 
-    return build_x, register_x, REGISTRY
+    def build(self, args, *extra_args, **extra_kwargs):
+        """Instantiate the implementation ``args.<name>`` selects.
+
+        The class's own argparse defaults are merged into ``args`` first, so
+        construction sees a complete namespace even when the two-phase CLI
+        parse was bypassed (tests, library use).  Classes may provide a
+        ``build_<name>`` classmethod to customize construction."""
+        key = getattr(args, self.name, None)
+        if key is None:
+            return None
+        cls = self.classes[key]
+        fill_defaults_from_add_args(args, cls)
+        builder = getattr(cls, f"build_{self.name}", cls)
+        return builder(args, *extra_args, **extra_kwargs)
 
 
-def set_defaults(args, cls):
-    """Inject the class's argparse defaults into *args* for any unset attr."""
+def setup_registry(flag: str, base_class=None, default=None, required=False):
+    assert flag.startswith("--")
+    name = flag[2:].replace("-", "_")
+    if name in REGISTRIES:
+        raise ValueError(f"Cannot setup duplicate registry: {name}")
+    reg = Registry(name, base_class=base_class, default=default)
+    REGISTRIES[name] = {"registry": reg.classes, "default": default}
+    return reg.build, reg.register, reg.classes
+
+
+def fill_defaults_from_add_args(args, cls):
+    """Set any attr missing from ``args`` to the default its ``add_args``
+    flag declares."""
     if not hasattr(cls, "add_args"):
         return
-    parser = argparse.ArgumentParser(
+    probe = argparse.ArgumentParser(
         argument_default=argparse.SUPPRESS, allow_abbrev=False
     )
-    cls.add_args(parser)
-    defaults = argparse.Namespace()
-    for action in parser._actions:
-        if action.dest is not argparse.SUPPRESS:
-            if not hasattr(defaults, action.dest):
-                if action.default is not argparse.SUPPRESS:
-                    setattr(defaults, action.dest, action.default)
-    for key, default_value in vars(defaults).items():
-        if not hasattr(args, key):
-            setattr(args, key, default_value)
+    cls.add_args(probe)
+    for action in probe._actions:
+        if action.dest is argparse.SUPPRESS or action.default is argparse.SUPPRESS:
+            continue
+        if not hasattr(args, action.dest):
+            setattr(args, action.dest, action.default)
+
+
+# historical name used by options.py and user plugins
+set_defaults = fill_defaults_from_add_args

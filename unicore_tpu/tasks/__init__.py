@@ -1,8 +1,13 @@
-"""Task registry (reference /root/reference/unicore/tasks/__init__.py:16-86)."""
+"""Task registry and auto-discovery.
 
-import argparse
+Parity surface (reference /root/reference/unicore/tasks/__init__.py:16-86):
+``@register_task("name")`` + ``setup_task(args)`` dispatch; bundled task
+modules (and task packages) self-register on import, and ``--user-dir``
+plugins use the same decorator.
+"""
+
 import importlib
-import os
+import pkgutil
 
 from .unicore_task import UnicoreTask
 
@@ -10,44 +15,38 @@ TASK_REGISTRY = {}
 TASK_CLASS_NAMES = set()
 
 
-def setup_task(args, **kwargs):
-    return TASK_REGISTRY[args.task].setup_task(args, **kwargs)
-
-
 def register_task(name):
-    """Decorator registering a :class:`UnicoreTask` subclass by name."""
+    """Decorator registering a :class:`UnicoreTask` subclass under ``name``."""
 
-    def register_task_cls(cls):
-        if name in TASK_REGISTRY:
-            raise ValueError(f"Cannot register duplicate task ({name})")
+    def deco(cls):
         if not issubclass(cls, UnicoreTask):
             raise ValueError(
                 f"Task ({name}: {cls.__name__}) must extend UnicoreTask"
             )
+        if name in TASK_REGISTRY:
+            raise ValueError(f"Cannot register duplicate task ({name})")
         if cls.__name__ in TASK_CLASS_NAMES:
             raise ValueError(
-                f"Cannot register task with duplicate class name ({cls.__name__})"
+                f"Cannot register task with duplicate class name "
+                f"({cls.__name__})"
             )
         TASK_REGISTRY[name] = cls
         TASK_CLASS_NAMES.add(cls.__name__)
         return cls
 
-    return register_task_cls
+    return deco
+
+
+def setup_task(args, **kwargs):
+    """Build the task ``args.task`` names via its ``setup_task`` hook."""
+    return TASK_REGISTRY[args.task].setup_task(args, **kwargs)
 
 
 def get_task(name):
     return TASK_REGISTRY[name]
 
 
-# Auto-import bundled tasks.
-tasks_dir = os.path.dirname(__file__)
-for file in sorted(os.listdir(tasks_dir)):
-    path = os.path.join(tasks_dir, file)
-    if (
-        not file.startswith("_")
-        and not file.startswith(".")
-        and (file.endswith(".py") or os.path.isdir(path))
-        and file != "unicore_task.py"
-    ):
-        task_name = file[: file.find(".py")] if file.endswith(".py") else file
-        importlib.import_module("unicore_tpu.tasks." + task_name)
+# import every bundled task module/package so its decorator runs
+for _mod in pkgutil.iter_modules(__path__):
+    if not _mod.name.startswith("_") and _mod.name != "unicore_task":
+        importlib.import_module(f"{__name__}.{_mod.name}")
