@@ -66,8 +66,10 @@ void* ir_open(const char* bin_path, const char* idx_path) {
     uint64_t n = 0;
     memcpy(&n, p + 8, 8);
     // validate before trusting: a truncated/corrupt index must fail open,
-    // not SIGSEGV later in ir_read
-    if (idx_size < 16 + 8 * (n + 1)) {
+    // not SIGSEGV later in ir_read.  Compare by division — the
+    // multiplication 8 * (n + 1) wraps for a corrupt n >= 2^61, which
+    // would bypass the bound and allow out-of-bounds offset reads
+    if ((idx_size - 16) / 8 < 1 || n > (idx_size - 16) / 8 - 1) {
         munmap(idx, idx_size);
         munmap(bin, bin_size);
         return nullptr;

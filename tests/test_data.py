@@ -255,3 +255,37 @@ def test_grouped_iterator():
     g = GroupedIterator(it.next_epoch_itr(shuffle=False), 4)
     groups = list(g)
     assert [len(x) for x in groups] == [4, 2]
+
+
+def test_native_reader_rejects_corrupt_index():
+    """A corrupt .idx with n >= 2^61 must fail open (the size check is
+    phrased divisionally so the bound can't integer-wrap) and a valid
+    index must still open."""
+    import ctypes
+    import os
+    import struct
+    import tempfile
+
+    so = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "csrc", "libunicore_tpu_native.so",
+    )
+    if not os.path.exists(so):
+        import pytest
+
+        pytest.skip("native reader not built")
+    lib = ctypes.CDLL(so)
+    lib.ir_open.restype = ctypes.c_void_p
+    lib.ir_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    d = tempfile.mkdtemp()
+    idx, binf = os.path.join(d, "x.idx"), os.path.join(d, "x.bin")
+    with open(binf, "wb") as f:
+        f.write(b"\0" * 8)
+    with open(idx, "wb") as f:
+        f.write(b"UCTPIDX1" + struct.pack("<Q", 1 << 61)
+                + struct.pack("<Q", 0) * 3)
+    assert not lib.ir_open(binf.encode(), idx.encode())
+    with open(idx, "wb") as f:
+        f.write(b"UCTPIDX1" + struct.pack("<Q", 2)
+                + struct.pack("<QQQ", 0, 4, 8))
+    assert lib.ir_open(binf.encode(), idx.encode())

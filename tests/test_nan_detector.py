@@ -53,3 +53,56 @@ def test_nan_detector_check_grads():
     assert det.check_grads(good) is None
     msg = det.check_grads(bad)
     assert msg is not None and "a" in msg
+
+
+def test_trainer_nan_rerun_localizes_and_aborts():
+    """--nan-rerun: a step with non-finite grads triggers an automatic
+    NanDetector re-run naming the bad parameter, then FloatingPointError
+    (reference trainer.py:727-748 operator experience)."""
+    from argparse import Namespace
+
+    import pytest
+
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    class _T(UnicoreTask):
+        class _D:
+            def pad(self):
+                return 1
+
+        dictionary = _D()
+
+    args = Namespace(
+        seed=1, bf16=False, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=0.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=1,
+        pipeline_parallel_size=1, expert_parallel_size=1,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8, weight_decay=0.0,
+        force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+        validate_with_ema=False, max_update=10, update_freq=[1],
+        donate_train_state=False, no_weight_decay_names="", nan_rerun=True,
+    )
+    model = BertModel(
+        vocab_size=32, padding_idx=1, encoder_layers=1, encoder_embed_dim=16,
+        encoder_ffn_embed_dim=32, encoder_attention_heads=2, max_seq_len=16,
+        post_ln=True, dropout=0.0, emb_dropout=0.0, attention_dropout=0.0,
+    )
+    tr = Trainer(args, _T(args), model, LOSS_REGISTRY["masked_lm"](_T(args)))
+
+    r = np.random.RandomState(0)
+    tok = r.randint(4, 32, size=(4, 16)).astype(np.int64)
+    tgt = np.where(r.rand(4, 16) < 0.3, tok, 1).astype(np.int64)
+    sample = {"net_input": {"src_tokens": tok}, "target": tgt}
+    tr.train_step([sample])  # clean step
+
+    # poison one parameter: the next forward/backward produces NaN grads
+    leaves, treedef = jax.tree_util.tree_flatten(tr._state["params"])
+    leaves[0] = leaves[0].at[(0,) * leaves[0].ndim].set(jnp.nan)
+    tr._state["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
+    with pytest.raises(FloatingPointError, match="non-finite gradients"):
+        tr.train_step([sample])

@@ -4,7 +4,6 @@ JAX/XLA/Pallas/pjit.
 """
 
 __version__ = "0.0.1"
-__all__ = ["pdb"]
 
 import unicore_tpu.utils  # noqa
 from unicore_tpu.distributed import utils as distributed_utils  # noqa

@@ -190,6 +190,13 @@ def get_parser(desc, default_task=None):
     parser.add_argument("--validate-with-ema", action="store_true")
     parser.add_argument("--debug-nans", action="store_true",
                         help="enable jax_debug_nans to localize the first NaN-producing op")
+    parser.add_argument("--nan-rerun", action="store_true",
+                        help="check for non-finite gradients after every "
+                             "update (costs one host sync per step) and, on "
+                             "detection, re-run the batch under the NaN "
+                             "detector to name the first bad module before "
+                             "aborting — the reference's automatic NanDetector "
+                             "re-run (its trainer.py:727-748)")
     parser.add_argument("--donate-train-state", action="store_true",
                         help="donate the train state buffers to the jitted step "
                              "(halves peak HBM; on some backends donation forces "

@@ -100,6 +100,7 @@ class Trainer(object):
 
         self._state = None  # lazy: needs an example batch for param init
         self._dummy_batch = None
+        self._nan_rerun_seen = 0.0  # overflow count already diagnosed
         self._cached_eval_params = None
         self._macc = None  # device-side metric sums (see flush_metrics)
         self._num_updates = 0
@@ -255,8 +256,62 @@ class Trainer(object):
     # jitted step builders
     # ------------------------------------------------------------------
 
+    def _forward_backward_per_sample(self, params, sample, rng, loss_scale,
+                                     weight):
+        """Per-SAMPLE gradient clipping (reference
+        per_sample_clip_grad_norm, optim/unicore_optimizer.py:110-130):
+        every sample's gradient is clipped to --per-sample-clip-norm before
+        accumulation.  The reference loops sample-by-sample into a grad
+        buffer; here one vmap computes all per-sample grads in a single
+        pass — memory is batch x params, which fits the feature's use case
+        (Uni-Fold-style finetuning at small batch)."""
+        per_clip = self.args.per_sample_clip_norm
+
+        # batched-ness must come from the ORIGINAL leaves: inside vmap the
+        # traced per-sample leaf has already lost its batch dim, so a (B,)
+        # leaf would look 0-d and skip re-batching
+        batched = jax.tree_util.tree_map(
+            lambda x: getattr(x, "ndim", 0) > 0, sample
+        )
+
+        def one_sample(s, r):
+            s1 = jax.tree_util.tree_map(
+                lambda x, b: x[None] if b else x, s, batched
+            )
+
+            def loss_for_grad(p):
+                loss, ss, log = self._loss_fn(p, s1, {"dropout": r}, True)
+                return loss.astype(jnp.float32) * loss_scale, (loss, ss, log)
+
+            (_, (loss, ss, log)), g = jax.value_and_grad(
+                loss_for_grad, has_aux=True
+            )(params)
+            g = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.float32), g
+            )
+            g, _ = utils.clip_grad_norm(g, per_clip * loss_scale)
+            log = {k: jnp.asarray(v, jnp.float32) for k, v in log.items()}
+            return g, ss.astype(jnp.float32), log
+
+        arr_axes = jax.tree_util.tree_map(
+            lambda b: 0 if b else None, batched
+        )
+        bsz = jax.tree_util.tree_leaves(sample)[0].shape[0]
+        rngs = jax.random.split(rng, bsz)
+        grads, sizes, logs = jax.vmap(one_sample, in_axes=(arr_axes, 0))(
+            sample, rngs
+        )
+        grads = jax.tree_util.tree_map(lambda g: g.sum(0) * weight, grads)
+        sample_size = sizes.sum() * weight
+        logging_output = {k: v.sum() * weight for k, v in logs.items()}
+        return grads, sample_size, logging_output
+
     def _forward_backward(self, params, sample, rng, loss_scale, weight):
         """Shared micro-batch forward+backward (pure)."""
+        if getattr(self.args, "per_sample_clip_norm", 0.0) > 0:
+            return self._forward_backward_per_sample(
+                params, sample, rng, loss_scale, weight
+            )
 
         def loss_for_grad(p):
             # phase names mirror the reference's record_function annotations
@@ -277,13 +332,6 @@ class Trainer(object):
         # accumulate in fp32 (reference --allreduce-fp32-grad is the default
         # safe behavior here; bf16 accumulation loses grad mass over scans)
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
-        per_clip = getattr(self.args, "per_sample_clip_norm", 0.0)
-        if per_clip > 0:
-            # clip each micro-batch's grads pre-sync (reference
-            # per_sample_clip_grad_norm, optim/unicore_optimizer.py:110-130)
-            grads, _ = utils.clip_grad_norm(
-                grads, per_clip * loss_scale * jnp.maximum(weight, 1e-8)
-            )
         sample_size = sample_size.astype(jnp.float32) * weight
         logging_output = {
             k: jnp.asarray(v, dtype=jnp.float32) * weight
@@ -583,8 +631,63 @@ class Trainer(object):
         self._state = new_state
         self._cached_eval_params = None
         self.set_num_updates(self.get_num_updates() + 1)
+
+        if getattr(self.args, "nan_rerun", False) and not self.use_loss_scale:
+            # opt-in reference parity (trainer.py:727-748): pay one host
+            # sync per step; on a fresh non-finite gradient, localize it by
+            # re-running this batch under the NaN detector, then abort
+            seen = float(jax.device_get(self._macc["overflow"]))
+            if seen > self._nan_rerun_seen:
+                self._nan_rerun_seen = seen
+                detail = self._localize_nan(samples)
+                metrics.log_stop_time("train_wall")
+                raise FloatingPointError(
+                    "non-finite gradients detected"
+                    + (f": {detail}" if detail else "")
+                )
+
         metrics.log_stop_time("train_wall")
         return True
+
+    def _localize_nan(self, samples):
+        """Eager re-run of the offending batch: forward with captured
+        intermediates names the first module producing NaN/Inf; a plain
+        grad pass names the first bad parameter gradient."""
+        from unicore_tpu.nan_detector import NanDetector
+
+        sample = next((s for s in samples if not self._is_empty(s)), None)
+        if sample is None:
+            return None
+        sample = self._prepare_sample(sample, init=True)
+        det = NanDetector(self.model)
+        params = self._state["params"]
+        msgs = []
+        try:
+            hit = det.check_forward(params, sample)
+            if hit:
+                msgs.append(hit)
+        except Exception as e:  # diagnostics must not mask the original error
+            logger.warning(f"NaN forward localization failed: {e}")
+        try:
+            # reconstruct the failing step's dropout key (same impl/folds as
+            # make_rng; micro index 0 is best-effort for uf>1) so dropout-
+            # dependent NaNs reproduce in the re-run
+            impl = "rbg" if jax.default_backend() in ("tpu", "axon") else None
+            rng = jax.random.key(np.int32(self.args.seed), impl=impl)
+            failed_step = np.int32(max(self.get_num_updates() - 1, 0))
+            for f in (failed_step, np.int32(0)):
+                rng = jax.random.fold_in(rng, f)
+            grads, _, _ = self._forward_backward(
+                params, sample, rng, jnp.ones((), jnp.float32),
+                jnp.ones((), jnp.float32),
+            )
+            hit = det.check_grads(grads)
+            if hit:
+                msgs.append(hit)
+                det.dump_grad_norms(grads)
+        except Exception as e:
+            logger.warning(f"NaN gradient localization failed: {e}")
+        return "; ".join(msgs) if msgs else None
 
     def flush_metrics(self):
         """Pull the device-side metric sums accumulated since the last flush
@@ -596,6 +699,7 @@ class Trainer(object):
         # never grow past the precision horizon on long runs
         delta = {k: float(v) for k, v in jax.device_get(self._macc).items()}
         self._macc = None
+        self._nan_rerun_seen = 0.0  # accumulator reset; re-arm the detector
         n = delta.pop("_n", 0.0)
         if n <= 0:
             return
