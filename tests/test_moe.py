@@ -1,0 +1,135 @@
+"""Mixture-of-Experts FFN + expert parallelism over the mesh 'expert' axis
+(modules/moe.py; SURVEY.md §2.3 EP — vestigial in the reference, first-class
+here)."""
+
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.modules.moe import MoELayer
+
+
+def test_top1_uncapped_equals_selected_expert():
+    """With top_k=1 and capacity >= all tokens, each token's output is
+    exactly its argmax expert's FFN (renormalized gate = 1)."""
+    E, D, F, B, S = 4, 16, 32, 2, 8
+    layer = MoELayer(
+        embed_dim=D, ffn_embed_dim=F, num_experts=E, top_k=1,
+        capacity_factor=float(E),  # cap = B*S: nothing drops
+        activation_fn="gelu",
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    params = layer.init({"params": jax.random.PRNGKey(1)}, x)
+    out, mod = layer.apply(params, x, mutable=("losses",))
+    p = params["params"]
+    tokens = x.reshape(-1, D)
+    logits = tokens @ p["router"]["kernel"] + p["router"]["bias"]
+    choice = jnp.argmax(logits, axis=-1)
+    w1, b1 = p["experts_fc1"], p["experts_bias1"]
+    w2, b2 = p["experts_fc2"], p["experts_bias2"]
+    h = jax.nn.gelu(
+        jnp.einsum("nd,ndf->nf", tokens, w1[choice]) + b1[choice],
+        approximate=False,
+    )
+    expect = (jnp.einsum("nf,nfd->nd", h, w2[choice]) + b2[choice]).reshape(
+        B, S, D
+    )
+    err = float(jnp.abs(out - expect).max())
+    assert err < 1e-4, err
+    # aux loss sown and in a sane range ([1, E] for E experts)
+    aux = jax.tree_util.tree_leaves(mod["losses"])[0]
+    assert 0.9 < float(jnp.sum(aux)) < E + 0.1
+
+
+def test_capacity_drops_overflow_tokens():
+    """A capacity of ~one token per expert must zero most tokens' outputs
+    (they fall through to the residual in the encoder layer)."""
+    E, D, F, B, S = 2, 8, 16, 1, 64
+    layer = MoELayer(
+        embed_dim=D, ffn_embed_dim=F, num_experts=E, top_k=1,
+        capacity_factor=8 * E / float(S),  # cap = 8 per expert
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    params = layer.init({"params": jax.random.PRNGKey(1)}, x)
+    out = layer.apply(params, x)
+    zero_rows = int(jnp.sum(jnp.all(jnp.abs(out[0]) < 1e-9, axis=-1)))
+    assert zero_rows >= S - 2 * 8  # at most cap tokens per expert survive
+
+
+def _mk_trainer(data, expert):
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    class _T(UnicoreTask):
+        class _D:
+            def pad(self):
+                return 1
+
+        dictionary = _D()
+
+    args = Namespace(
+        seed=1, bf16=False, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=1.0, per_sample_clip_norm=0.0,
+        data_parallel_size=data, model_parallel_size=1, seq_parallel_size=1,
+        pipeline_parallel_size=1, expert_parallel_size=expert,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8, weight_decay=0.0,
+        force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+        validate_with_ema=False, max_update=10, update_freq=[1],
+        donate_train_state=False, no_weight_decay_names="",
+        moe_aux_loss_weight=0.01,
+    )
+    model = BertModel(
+        vocab_size=64, padding_idx=1, encoder_layers=2, encoder_embed_dim=32,
+        encoder_ffn_embed_dim=64, encoder_attention_heads=4, max_seq_len=32,
+        post_ln=True, dropout=0.0, emb_dropout=0.0, attention_dropout=0.0,
+        moe_experts=4, moe_every=2, moe_top_k=2,
+    )
+    loss = LOSS_REGISTRY["masked_lm_moe"](_T(args))
+    return Trainer(args, _T(args), model, loss)
+
+
+def _sample(seed=0, rows=8):
+    r = np.random.RandomState(seed)
+    tok = r.randint(4, 64, size=(rows, 32)).astype(np.int64)
+    tgt = np.where(r.rand(rows, 32) < 0.25, tok, 1).astype(np.int64)
+    return {"net_input": {"src_tokens": tok}, "target": tgt}
+
+
+def test_expert_parallel_matches_pure_dp():
+    """A dp=4 x ep=2 mesh must produce the same training trajectory as
+    dp=8 (pure data parallel): expert sharding is a layout change only."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    results = []
+    for data, expert in ((8, 1), (4, 2)):
+        tr = _mk_trainer(data, expert)
+        tr.train_step([_sample(0)])
+        tr.train_step([_sample(1)])
+        macc = {k: float(v) for k, v in jax.device_get(tr._macc).items()}
+        leaves = jax.device_get(
+            jax.tree_util.tree_leaves(tr._state["params"])
+        )
+        results.append((macc, leaves))
+    (m_dp, p_dp), (m_ep, p_ep) = results
+    assert abs(m_dp["loss"] - m_ep["loss"]) / max(abs(m_dp["loss"]), 1) < 1e-5
+    err = max(float(np.abs(a - b).max()) for a, b in zip(p_dp, p_ep))
+    assert err < 1e-5, err
+    # the expert weights really are sharded over the expert axis
+    tr = _mk_trainer(4, 2)
+    tr.init_state(_sample(0))
+    flat = jax.tree_util.tree_flatten_with_path(tr._state["params"])[0]
+    expert_leaves = [
+        (path, leaf) for path, leaf in flat if "experts_fc1" in str(path)
+    ]
+    assert expert_leaves, "no expert params found"
+    for _, leaf in expert_leaves:
+        spec = leaf.sharding.spec
+        assert spec and spec[0] == "expert", spec

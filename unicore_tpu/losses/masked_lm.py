@@ -36,8 +36,8 @@ class MaskedLMLoss(UnicoreLoss):
                 rngs, train,
             )
 
-        logits = model.apply(
-            params,
+        logits, aux = self._apply_model(
+            model, params,
             **sample["net_input"],
             masked_tokens=masked_tokens,
             train=train,
@@ -49,7 +49,12 @@ class MaskedLMLoss(UnicoreLoss):
         safe_target = jnp.where(masked_tokens, target, 0)
         nll = -jnp.take_along_axis(lprobs, safe_target[..., None], axis=-1)[..., 0]
         loss = jnp.sum(jnp.where(masked_tokens, nll, 0.0))
+        loss = loss + aux * sample_size
         return loss, sample_size, self._logging(loss, target, sample_size)
+
+    # hook: the MoE variant collects sown auxiliary losses here
+    def _apply_model(self, model, params, **kwargs):
+        return model.apply(params, **kwargs), 0.0
 
     def _forward_gather(
         self, model, params, sample, target, masked_tokens, sample_size,
@@ -64,8 +69,8 @@ class MaskedLMLoss(UnicoreLoss):
         # broken by lowest index), padded with unmasked positions
         vals, positions = jax.lax.top_k(masked_tokens.astype(jnp.int32), n_masked)
         valid = vals > 0
-        logits = model.apply(
-            params,
+        logits, aux = self._apply_model(
+            model, params,
             **sample["net_input"],
             masked_tokens=masked_tokens,
             masked_positions=positions,
@@ -79,6 +84,7 @@ class MaskedLMLoss(UnicoreLoss):
         safe_target = jnp.where(valid, gathered_target, 0)
         nll = -jnp.take_along_axis(lprobs, safe_target[..., None], axis=-1)[..., 0]
         loss = jnp.sum(jnp.where(valid, nll, 0.0))
+        loss = loss + aux * sample_size
         return loss, sample_size, self._logging(loss, target, sample_size)
 
     def _logging(self, loss, target, sample_size):
@@ -101,6 +107,29 @@ class MaskedLMLoss(UnicoreLoss):
             "loss", loss_sum / sample_size / jnp.log(2), sample_size, round=3
         )
         metrics.log_scalar("seq_len", seq_len / bsz, 1, round=3)
+
+
+@register_loss("masked_lm_moe")
+class MaskedLMMoELoss(MaskedLMLoss):
+    """Masked LM + the router load-balance auxiliary loss sown by MoE
+    layers (modules/moe.py).  Use with --arch bert_moe_* / --moe-experts."""
+
+    def __init__(self, task, moe_aux_loss_weight: float = 0.01):
+        super().__init__(task)
+        self.moe_aux_loss_weight = moe_aux_loss_weight
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument(
+            "--moe-aux-loss-weight", default=0.01, type=float,
+            help="weight of the MoE router load-balance loss",
+        )
+
+    def _apply_model(self, model, params, **kwargs):
+        out, mod_vars = model.apply(params, mutable=("losses",), **kwargs)
+        sown = jax.tree_util.tree_leaves(mod_vars.get("losses", {}))
+        aux = sum(jnp.sum(a) for a in sown) if sown else jnp.zeros(())
+        return out, self.moe_aux_loss_weight * aux
 
     @staticmethod
     def logging_outputs_can_be_summed(is_train) -> bool:

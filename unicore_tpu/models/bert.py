@@ -94,6 +94,11 @@ class BertModel(BaseUnicoreModel):
     post_ln: bool = True
     remat: bool = False  # activation checkpointing (--activation-checkpoint)
     num_classes: int = -1  # >0 adds a classification head
+    # mixture-of-experts FFN (expert parallelism over the mesh 'expert'
+    # axis, modules/moe.py); 0 = dense FFN everywhere
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
 
     @classmethod
     def add_args(cls, parser):
@@ -126,6 +131,14 @@ class BertModel(BaseUnicoreModel):
         parser.add_argument("--activation-checkpoint", action="store_true",
                             help="rematerialize encoder layers in the backward "
                                  "pass (trade FLOPs for activation memory)")
+        parser.add_argument("--moe-experts", type=int,
+                            help="number of routed FFN experts (0 = dense); "
+                                 "shards over the mesh 'expert' axis")
+        parser.add_argument("--moe-every", type=int,
+                            help="swap the FFN every N-th layer when "
+                                 "--moe-experts > 0")
+        parser.add_argument("--moe-top-k", type=int,
+                            help="experts per token")
 
     @classmethod
     def build_model(cls, args, task):
@@ -148,6 +161,9 @@ class BertModel(BaseUnicoreModel):
             post_ln=args.post_ln,
             remat=getattr(args, "activation_checkpoint", False),
             num_classes=getattr(args, "num_classes", -1),
+            moe_experts=getattr(args, "moe_experts", 0) or 0,
+            moe_every=getattr(args, "moe_every", 2) or 2,
+            moe_top_k=getattr(args, "moe_top_k", 2) or 2,
         )
 
     def setup(self):
@@ -181,6 +197,9 @@ class BertModel(BaseUnicoreModel):
             max_rel_pos=128,
             post_ln=self.post_ln,
             remat=self.remat,
+            moe_experts=self.moe_experts,
+            moe_every=self.moe_every,
+            moe_top_k=self.moe_top_k,
             name="sentence_encoder",
         )
         self.lm_head = BertLMHead(
@@ -254,6 +273,9 @@ def base_architecture(args):
     args.activation_fn = getattr(args, "activation_fn", "gelu")
     args.pooler_activation_fn = getattr(args, "pooler_activation_fn", "tanh")
     args.post_ln = getattr(args, "post_ln", True)
+    args.moe_experts = getattr(args, "moe_experts", 0)
+    args.moe_every = getattr(args, "moe_every", 2)
+    args.moe_top_k = getattr(args, "moe_top_k", 2)
 
 
 @register_model_architecture("bert", "bert_base")
@@ -277,6 +299,18 @@ def bert_tiny_architecture(args):
     args.encoder_ffn_embed_dim = getattr(args, "encoder_ffn_embed_dim", 128)
     args.encoder_attention_heads = getattr(args, "encoder_attention_heads", 4)
     args.max_seq_len = getattr(args, "max_seq_len", 128)
+    base_architecture(args)
+
+
+@register_model_architecture("bert", "bert_moe_tiny")
+def bert_moe_tiny_architecture(args):
+    args.moe_experts = getattr(args, "moe_experts", 4)
+    bert_tiny_architecture(args)
+
+
+@register_model_architecture("bert", "bert_moe_base")
+def bert_moe_base_architecture(args):
+    args.moe_experts = getattr(args, "moe_experts", 8)
     base_architecture(args)
 
 

@@ -40,10 +40,16 @@ class BaseUnicoreModel(nn.Module):
         """Initialize the parameter pytree from an example batch.
 
         Default: call the module with the batch's ``net_input``.  Subclasses
-        with non-standard signatures override this.
+        with non-standard signatures override this.  Diagnostic collections
+        (sown aux losses, captured intermediates) are not parameters and are
+        stripped from the returned tree.
         """
         net_input = sample["net_input"] if "net_input" in sample else sample
-        return self.init({"params": rng, "dropout": rng}, **net_input)
+        variables = self.init({"params": rng, "dropout": rng}, **net_input)
+        return {
+            k: v for k, v in variables.items()
+            if k not in ("losses", "intermediates")
+        }
 
     def get_targets(self, sample, net_output):
         """Get targets from either the sample or the net's output."""

@@ -181,6 +181,18 @@ class TransformerEncoder(nn.Module):
     remat: bool = False  # activation checkpointing per layer
                          # (reference utils.checkpoint_sequential, utils.py:306-333)
     use_ring: bool = False  # seq-parallel ring attention (mesh 'seq' axis)
+    # mixture-of-experts FFN (expert parallelism, modules/moe.py): every
+    # moe_every-th layer swaps its dense FFN for num_experts routed experts
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # pipeline parallelism (parallel/pipeline.py): layers stacked on a
+    # leading axis sharded over the mesh 'pipe' axis, GPipe microbatch
+    # schedule.  0 = off.  Requires encoder_layers % pipe == 0 and
+    # batch % pipeline_microbatches == 0.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     def setup(self):
         self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
@@ -188,13 +200,21 @@ class TransformerEncoder(nn.Module):
         if not self.post_ln:
             self.final_layer_norm = LayerNorm(self.embed_dim, name="final_layer_norm")
         layer_cls = TransformerEncoderLayer
+        moe_cls = None
+        if self.moe_experts > 0:
+            from .moe import MoEEncoderLayer
+
+            moe_cls = MoEEncoderLayer
         if self.remat:
             # static argnums (incl. self at 0): return_attn=4, train=5
             layer_cls = nn.remat(
                 TransformerEncoderLayer, static_argnums=(4, 5)
             )
-        self.layers = [
-            layer_cls(
+            if moe_cls is not None:
+                moe_cls = nn.remat(moe_cls, static_argnums=(4, 5))
+
+        def build_layer(i):
+            common = dict(
                 embed_dim=self.embed_dim,
                 ffn_embed_dim=self.ffn_embed_dim,
                 attention_heads=self.attention_heads,
@@ -206,8 +226,51 @@ class TransformerEncoder(nn.Module):
                 use_ring=self.use_ring,
                 name=f"layers_{i}",
             )
-            for i in range(self.encoder_layers)
-        ]
+            # every moe_every-th layer (starting at moe_every - 1, so layer 0
+            # stays dense — the common interleaved-MoE recipe)
+            if moe_cls is not None and i % self.moe_every == self.moe_every - 1:
+                return moe_cls(
+                    num_experts=self.moe_experts,
+                    top_k=self.moe_top_k,
+                    capacity_factor=self.moe_capacity_factor,
+                    **common,
+                )
+            return layer_cls(**common)
+
+        if self.pipeline_stages > 1:
+            # stacked per-layer params for the GPipe schedule: leading dim
+            # num_layers, sharded over 'pipe' by DEFAULT_PP_RULES
+            assert self.moe_experts == 0, "MoE inside the pipeline: unsupported"
+            template = TransformerEncoderLayer(
+                embed_dim=self.embed_dim,
+                ffn_embed_dim=self.ffn_embed_dim,
+                attention_heads=self.attention_heads,
+                dropout=self.dropout,
+                attention_dropout=self.attention_dropout,
+                activation_dropout=self.activation_dropout,
+                activation_fn=self.activation_fn,
+                post_ln=self.post_ln,
+            )
+            self._pipe_template = template
+
+            def stack_init(rng):
+                dummy = jnp.zeros((1, 8, self.embed_dim), jnp.float32)
+                keys = jax.random.split(rng, self.encoder_layers)
+                per = [
+                    template.init({"params": k}, dummy, None, None, False,
+                                  False)["params"]
+                    for k in keys
+                ]
+                return jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *per
+                )
+
+            self.pipeline_stack = self.param("pipeline_stack", stack_init)
+            self.layers = []
+        else:
+            self.layers = [
+                build_layer(i) for i in range(self.encoder_layers)
+            ]
         if self.rel_pos:
             assert self.rel_pos_bins % 2 == 0
             self.relative_attention_bias = nn.Embed(
@@ -264,11 +327,83 @@ class TransformerEncoder(nn.Module):
         # the fused path as an additive -inf) — unlike the reference, which
         # materializes a (B*H, L, L) merged tensor (transformer_encoder.py:147-155)
 
-        for layer in self.layers:
-            # positional: nn.remat requires static args positionally, and the
-            # same form is valid for the plain layer
-            x = layer(x, attn_bias, padding_mask, False, train)
+        if self.pipeline_stages > 1:
+            x = self._pipeline_forward(x, attn_bias, padding_mask, train)
+        else:
+            for layer in self.layers:
+                # positional: nn.remat requires static args positionally,
+                # and the same form is valid for the plain layer
+                x = layer(x, attn_bias, padding_mask, False, train)
 
         if not self.post_ln:
             x = self.final_layer_norm(x)
         return x
+
+    def _pipeline_forward(self, x, attn_bias, padding_mask, train):
+        """GPipe schedule over the mesh 'pipe' axis (parallel/pipeline.py)."""
+        from jax.sharding import PartitionSpec as P
+
+        from unicore_tpu.parallel import DATA_AXIS, get_global_mesh
+        from unicore_tpu.parallel.mesh import PIPE_AXIS
+        from unicore_tpu.parallel.pipeline import gpipe
+
+        mesh = get_global_mesh()
+        assert mesh is not None and mesh.shape[PIPE_AXIS] == self.pipeline_stages, (
+            f"pipeline_stages={self.pipeline_stages} needs a global mesh "
+            f"with a matching 'pipe' axis (got "
+            f"{None if mesh is None else dict(mesh.shape)})"
+        )
+        B, L, D = x.shape
+        n_micro = self.pipeline_microbatches
+        assert B % n_micro == 0, (
+            f"batch {B} must divide pipeline_microbatches {n_micro}"
+        )
+        mb = B // n_micro
+        template = self._pipe_template
+
+        if padding_mask is None:
+            padding_mask = jnp.zeros((B, L), jnp.int32)
+        mbs = {
+            "x": x.reshape(n_micro, mb, L, D),
+            "pm": padding_mask.reshape(n_micro, mb, L),
+        }
+        consts = {} if attn_bias is None else {"bias": attn_bias}
+        has_dropout = train and (
+            self.dropout > 0 or self.attention_dropout > 0
+            or self.activation_dropout > 0
+        )
+        rng = self.make_rng("dropout") if has_dropout else None
+
+        def stage_apply(p_stack, tree, step_rng):
+            mb_tree, consts_ = tree
+            h, pm = mb_tree["x"], mb_tree["pm"]
+            bias = consts_.get("bias") if consts_ else None
+
+            def body(carry, xs):
+                p_layer, li = xs
+                rngs = None
+                if step_rng is not None:
+                    rngs = {"dropout": jax.random.fold_in(step_rng, li)}
+                out = template.apply(
+                    {"params": p_layer}, carry, bias, pm, False, train,
+                    rngs=rngs,
+                )
+                return out, None
+
+            n_local = jax.tree_util.tree_leaves(p_stack)[0].shape[0]
+            h, _ = jax.lax.scan(
+                body, h, (p_stack, jnp.arange(n_local, dtype=jnp.int32))
+            )
+            return {"x": h, "pm": pm}
+
+        batched = P(None, DATA_AXIS) if DATA_AXIS in mesh.shape else P()
+        outs = gpipe(
+            mesh,
+            stage_apply,
+            self.pipeline_stack,
+            mbs,
+            consts,
+            rng=rng,
+            mb_spec=batched,
+        )
+        return outs["x"].reshape(B, L, D)

@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS
+from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +39,21 @@ DEFAULT_TP_RULES = [
     (r".*(fc2|down_proj|wo).*kernel", P(MODEL_AXIS, None)),
     # embeddings: shard vocab dim
     (r".*embed_tokens.*embedding", P(MODEL_AXIS, None)),
+]
+
+# Expert-parallel rules: MoE expert weights carry a leading num_experts dim
+# (modules/moe.py) sharded over the 'expert' mesh axis; XLA emits the token
+# all-to-alls from these annotations.
+DEFAULT_EP_RULES = [
+    (r".*experts_fc(1|2)", P(EXPERT_AXIS, None, None)),
+    (r".*experts_bias(1|2)", P(EXPERT_AXIS, None)),
+]
+
+# Pipeline-parallel rules: stacked per-layer params (leading num_layers dim,
+# modules/transformer_encoder.py pipeline_stack) shard over 'pipe' so each
+# rank holds only its stage's weights.
+DEFAULT_PP_RULES = [
+    (r".*pipeline_stack.*", P(PIPE_AXIS)),
 ]
 
 
@@ -88,11 +103,22 @@ def params_pspecs(params, use_tp: bool = False, rules=None, mesh: Mesh = None):
     data axis are then emitted by XLA automatically.
     """
     axis_sizes = dict(mesh.shape) if mesh is not None else None
+    use_ep = mesh is not None and mesh.shape.get(EXPERT_AXIS, 1) > 1
+    use_pp = mesh is not None and mesh.shape.get(PIPE_AXIS, 1) > 1
 
     def spec_for(path, leaf):
+        p = _path_str(path)
+        if use_pp:
+            s = param_spec(p, leaf.shape, DEFAULT_PP_RULES, axis_sizes)
+            if s != P():
+                return s
+        if use_ep:
+            s = param_spec(p, leaf.shape, DEFAULT_EP_RULES, axis_sizes)
+            if s != P():
+                return s
         if not use_tp:
             return P()
-        return param_spec(_path_str(path), leaf.shape, rules, axis_sizes)
+        return param_spec(p, leaf.shape, rules, axis_sizes)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
 

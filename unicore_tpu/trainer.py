@@ -84,8 +84,13 @@ class Trainer(object):
             self.compute_dtype = jnp.float32
         self.use_loss_scale = bool(args.fp16)
 
-        # device mesh: single source of truth for all parallel axes
+        # device mesh: single source of truth for all parallel axes; also
+        # published globally for modules that look the mesh up at trace
+        # time (ring attention's 'seq' axis, the pipeline's 'pipe' axis)
         self.mesh = make_mesh_from_args(args)
+        from unicore_tpu.parallel import set_global_mesh
+
+        set_global_mesh(self.mesh)
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
 
