@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""On-TPU attention-kernel shootout: which path should the module pick?
+
+Times the three attention implementations the module router can choose
+between (modules/multihead_attention.py):
+
+  fullrow  one-shot softmax over the whole row, single fused backward
+           (ops/attention_fullrow.py — built for the bundled <=512 shapes)
+  flash    blockwise-online softmax, two-pass backward
+           (ops/flash_attention.py), swept over (block_q, block_k)
+  xla      fused-softmax XLA path (ops/softmax_dropout.py route) —
+           materializes the attention matrix; the fallback
+
+for the shapes the bundled model families actually run (BERT-base seq
+512/256, Uni-Mol pair-bias seq 256), forward and forward+backward, with and
+without bias/dropout.  One JSON line per (path, config); `best` summary
+lines at the end name the winner per config — feed that into the router
+defaults.
+
+Usage (real TPU; falls back to interpret-mode CPU only for smoke):
+    python scripts/bench_attention.py             # full sweep
+    BENCH_ATTN_REPS=50 python scripts/bench_attention.py
+Results append to BENCH_PARTIAL.jsonl like bench.py so a later hang can't
+lose earlier rows.
+"""
+
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPS = int(os.environ.get("BENCH_ATTN_REPS", "30"))
+PARTIAL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_PARTIAL.jsonl",
+)
+
+
+def _emit(row):
+    line = json.dumps(row)
+    print(line, flush=True)
+    try:
+        with open(PARTIAL, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
+
+
+def _time(fn, *args):
+    """Median-of-3 wall time for REPS dispatches, real-fetch barrier (the
+    tunnel's block_until_ready can return early — see bench.py)."""
+    import jax
+    import numpy as np
+
+    out = fn(*args)  # compile
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    times = []
+    for _i in range(3):
+        t0 = time.perf_counter()
+        for _j in range(REPS):
+            out = fn(*args)
+        _ = np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        times.append((time.perf_counter() - t0) / REPS)
+    return sorted(times)[1]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.ops.flash_attention import flash_attention, mha_reference
+    from unicore_tpu.ops.attention_fullrow import (
+        fullrow_attention, supported as fullrow_supported,
+    )
+
+    global REPS
+    kind = jax.devices()[0].device_kind
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if not on_tpu:
+        from unicore_tpu.ops._pallas import set_interpret
+
+        set_interpret(True)
+        REPS = 2
+    print(f"# device={kind} backend={jax.default_backend()} reps={REPS}",
+          file=sys.stderr)
+
+    # (name, B, H, L, D, bias?) — the bundled families' hot shapes
+    configs = [
+        ("bert_seq512", 16, 12, 512, 64, False),
+        ("bert_seq256", 32, 12, 256, 64, False),
+        ("unimol_pair_seq256", 16, 8, 256, 64, True),  # pair bias (1,H,L,L)
+    ]
+    flash_blocks = [(128, 128), (128, 256), (256, 256), (256, 512),
+                    (512, 512)]
+    if not on_tpu:  # interpret-mode smoke: one tiny shape, timings bogus
+        configs = [("smoke_seq128", 1, 2, 128, 32, True)]
+        flash_blocks = [(128, 128)]
+
+    best = {}
+    for name, B, H, L, D, with_bias in configs:
+        key = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(jax.random.fold_in(key, i), (B, H, L, D),
+                              jnp.bfloat16)
+            for i in range(3)
+        )
+        bias = (
+            jax.random.normal(jax.random.fold_in(key, 7), (1, H, L, L),
+                              jnp.float32)
+            if with_bias else None
+        )
+        sm = D ** -0.5
+
+        candidates = []
+        if fullrow_supported(L, L, D, 1 if with_bias else None):
+            candidates.append((
+                "fullrow",
+                lambda q, k, v: fullrow_attention(
+                    q, k, v, bias=bias, sm_scale=sm
+                ),
+            ))
+        for bq, bk in flash_blocks:
+            if L % min(bq, 128) or bq > L or bk > L:
+                continue
+            candidates.append((
+                f"flash_bq{bq}_bk{bk}",
+                lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, bias=bias, sm_scale=sm, block_q=bq, block_k=bk
+                ),
+            ))
+        candidates.append((
+            "xla",
+            lambda q, k, v: mha_reference(q, k, v, bias=bias, sm_scale=sm),
+        ))
+
+        for path, fn in candidates:
+            row = {"config": name, "path": path, "shape": [B, H, L, D],
+                   "bias": with_bias, "device_kind": kind}
+            try:
+                fwd = jax.jit(fn)
+                row["fwd_ms"] = round(_time(fwd, q, k, v) * 1e3, 3)
+
+                def loss(q, k, v, fn=fn):
+                    return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+                fb = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                row["fwdbwd_ms"] = round(_time(fb, q, k, v) * 1e3, 3)
+            except Exception as e:
+                row["error"] = repr(e)[:300]
+            _emit(row)
+            if "fwdbwd_ms" in row:
+                cur = best.get(name)
+                if cur is None or row["fwdbwd_ms"] < cur["fwdbwd_ms"]:
+                    best[name] = {"path": path,
+                                  "fwdbwd_ms": row["fwdbwd_ms"]}
+
+    for name, win in best.items():
+        _emit({"config": name, "best": win["path"],
+               "fwdbwd_ms": win["fwdbwd_ms"], "device_kind": kind})
+
+
+if __name__ == "__main__":
+    main()

@@ -289,3 +289,25 @@ def test_native_reader_rejects_corrupt_index():
         f.write(b"UCTPIDX1" + struct.pack("<Q", 2)
                 + struct.pack("<QQQ", 0, 4, 8))
     assert lib.ir_open(binf.encode(), idx.encode())
+
+
+def test_buffered_iterator_exhaustion_is_sticky():
+    """Pulling past the end must keep raising StopIteration, never block:
+    GroupedIterator's chunking pulls once more after a final partial chunk
+    (regression: that extra pull deadlocked the epoch boundary)."""
+    import itertools
+
+    from unicore_tpu.data.iterators import BufferedIterator, GroupedIterator
+
+    it = BufferedIterator(2, list(range(5)))
+    assert list(itertools.islice(it, 5)) == [0, 1, 2, 3, 4]
+    for _ in range(3):  # repeated post-exhaustion pulls: fast StopIteration
+        with pytest.raises(StopIteration):
+            next(it)
+
+    # the original deadlock shape: 5 items grouped in chunks of 2 — the
+    # last chunk is partial, and the grouped iterator's next pull must end
+    # the epoch instead of hanging
+    grouped = GroupedIterator(BufferedIterator(2, list(range(5))), 2)
+    chunks = list(grouped)
+    assert [len(c) for c in chunks] == [2, 2, 1]

@@ -133,3 +133,83 @@ def test_expert_parallel_matches_pure_dp():
     for _, leaf in expert_leaves:
         spec = leaf.sharding.spec
         assert spec and spec[0] == "expert", spec
+
+
+def test_scatter_dispatch_matches_dense():
+    """The scatter/gather dispatch (default, memory-safe) must reproduce the
+    dense one-hot einsum formulation exactly — forward AND input/param
+    gradients — including under capacity overflow and top-2 routing."""
+    E, D, F, B, S = 4, 16, 32, 2, 24
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+
+    def build(dispatch, capacity_factor):
+        return MoELayer(
+            embed_dim=D, ffn_embed_dim=F, num_experts=E, top_k=2,
+            capacity_factor=capacity_factor, dispatch=dispatch,
+        )
+
+    for cf in (4.0, 0.35):  # roomy and overflowing capacities
+        dense = build("dense", cf)
+        scat = build("scatter", cf)
+        params = dense.init({"params": jax.random.PRNGKey(1)}, x)
+
+        out_d = dense.apply(params, x)
+        out_s = scat.apply(params, x)
+        assert float(jnp.abs(out_d - out_s).max()) < 1e-5, cf
+
+        def loss_fn(layer):
+            def f(p, inp):
+                return jnp.sum(layer.apply(p, inp) ** 2)
+            return f
+
+        gd_p, gd_x = jax.grad(loss_fn(dense), argnums=(0, 1))(params, x)
+        gs_p, gs_x = jax.grad(loss_fn(scat), argnums=(0, 1))(params, x)
+        assert float(jnp.abs(gd_x - gs_x).max()) < 1e-4, cf
+        for a, b in zip(
+            jax.tree_util.tree_leaves(gd_p), jax.tree_util.tree_leaves(gs_p)
+        ):
+            assert float(jnp.abs(a - b).max()) < 1e-4, cf
+
+
+def test_overflow_metric_sown():
+    """moe_overflow (fraction of routes dropped by the capacity bound) is
+    sown to the 'metrics' collection: ~0 with room, large when starved."""
+    E, D, F, B, S = 2, 8, 16, 1, 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    for cf, lo, hi in ((8.0, -0.01, 0.01), (2 * 8 / float(S), 0.5, 1.0)):
+        layer = MoELayer(
+            embed_dim=D, ffn_embed_dim=F, num_experts=E, top_k=1,
+            capacity_factor=cf,
+        )
+        params = layer.init({"params": jax.random.PRNGKey(1)}, x)
+        _, mod = layer.apply(params, x, mutable=("losses", "metrics"))
+        leaves = jax.tree_util.tree_leaves(mod["metrics"])
+        assert leaves, "moe_overflow not sown"
+        frac = float(leaves[0])
+        assert lo <= frac <= hi, (cf, frac)
+
+
+def test_moe_init_params_strips_sown_collections():
+    """init_params must return ONLY trainable collections: leaked sown
+    'losses'/'metrics' entries would be optimizer-updated and would
+    contaminate apply-time sows (review finding, round 3)."""
+    from unicore_tpu.models.bert import BertModel
+
+    model = BertModel(
+        vocab_size=64, padding_idx=1, encoder_layers=2,
+        encoder_embed_dim=32, encoder_ffn_embed_dim=64,
+        encoder_attention_heads=4, max_seq_len=32, post_ln=True,
+        moe_experts=2, moe_every=1, moe_top_k=1,
+    )
+    sample = _sample(0, rows=2)
+    params = model.init_params(jax.random.PRNGKey(0), sample)
+    assert set(params.keys()) == {"params"}, set(params.keys())
+
+    # and the live apply sees exactly one sown leaf per MoE layer
+    out, mod = model.apply(
+        params, jnp.asarray(sample["net_input"]["src_tokens"]),
+        mutable=("losses", "metrics"),
+    )
+    n_moe_layers = 2  # moe_every=1, 2 layers
+    assert len(jax.tree_util.tree_leaves(mod["losses"])) == n_moe_layers
+    assert len(jax.tree_util.tree_leaves(mod["metrics"])) == n_moe_layers

@@ -1,0 +1,73 @@
+"""RESOURCE_EXHAUSTED at compile/first-dispatch must be re-raised as an
+actionable MemoryError naming the batch, mesh, and state footprint
+(round-2 verdict, missing #2: a raw XlaRuntimeError is operator-hostile)."""
+
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from unicore_tpu.losses import LOSS_REGISTRY
+from unicore_tpu.models.bert import BertModel
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+from unicore_tpu.trainer import Trainer
+
+
+class _Task(UnicoreTask):
+    class _D:
+        def pad(self):
+            return 1
+
+    dictionary = _D()
+
+
+def _tiny_trainer():
+    args = Namespace(
+        seed=1, bf16=False, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=1.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=1,
+        pipeline_parallel_size=1, expert_parallel_size=1,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8, weight_decay=0.0,
+        force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+        validate_with_ema=False, max_update=10, update_freq=[1],
+    )
+    model = BertModel(
+        vocab_size=64, padding_idx=1, encoder_layers=1, encoder_embed_dim=32,
+        encoder_ffn_embed_dim=64, encoder_attention_heads=4, max_seq_len=16,
+        post_ln=True,
+    )
+    return Trainer(args, _Task(args), model, LOSS_REGISTRY["masked_lm"](_Task(args)))
+
+
+def _sample():
+    r = np.random.RandomState(0)
+    tok = r.randint(4, 64, size=(8, 16)).astype(np.int64)
+    tgt = np.where(r.rand(8, 16) < 0.2, tok, 1).astype(np.int64)
+    return {"net_input": {"src_tokens": tok}, "target": tgt}
+
+
+def test_resource_exhausted_is_enriched():
+    tr = _tiny_trainer()
+    sample = _sample()
+    tr.init_state(sample)
+    with pytest.raises(MemoryError) as ei:
+        with tr._oom_guard(sample):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+                "34359738368 bytes."
+            )
+    msg = str(ei.value)
+    assert "mesh" in msg
+    assert "(8, 16)" in msg  # the batch geometry
+    assert "--update-freq" in msg and "--activation-checkpoint" in msg
+    assert "RESOURCE_EXHAUSTED" in msg
+
+
+def test_other_errors_pass_through():
+    tr = _tiny_trainer()
+    sample = _sample()
+    with pytest.raises(ValueError):
+        with tr._oom_guard(sample):
+            raise ValueError("unrelated")

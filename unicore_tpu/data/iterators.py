@@ -428,6 +428,7 @@ class BufferedIterator(object):
         self._queue = queue.Queue(size)
         self._iterable = iterable
         self._producer = None
+        self._exhausted = False
         self._started = time.time()
         self._last_warn = None
         self.total = len(iterable)
@@ -478,6 +479,11 @@ class BufferedIterator(object):
         self._last_warn = now
 
     def __next__(self):
+        # exhaustion must be sticky: a grouped/sliced consumer pulls once
+        # more after the final partial chunk, and blocking on the drained
+        # queue then would deadlock the epoch boundary
+        if self._exhausted:
+            raise StopIteration()
         if self._producer is None:
             self._start_producer()
         self._maybe_warn_starved()
@@ -485,5 +491,6 @@ class BufferedIterator(object):
         if isinstance(item, Exception):
             raise item
         if item is _DONE:
+            self._exhausted = True
             raise StopIteration()
         return item

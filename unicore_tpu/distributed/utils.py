@@ -93,7 +93,11 @@ def get_data_parallel_group():
 
 
 def get_data_parallel_rank() -> int:
-    return jax.process_index()
+    """Rank of this host's first device in the (default all-device) data
+    group — consistent with :func:`get_data_parallel_world_size` counting
+    devices, not hosts.  Meshed trainers use ``Trainer.data_parallel_rank``,
+    which accounts for non-data mesh axes."""
+    return jax.process_index() * jax.local_device_count()
 
 
 def get_data_parallel_world_size() -> int:

@@ -126,10 +126,41 @@ class MaskedLMMoELoss(MaskedLMLoss):
         )
 
     def _apply_model(self, model, params, **kwargs):
-        out, mod_vars = model.apply(params, mutable=("losses",), **kwargs)
+        out, mod_vars = model.apply(
+            params, mutable=("losses", "metrics"), **kwargs
+        )
         sown = jax.tree_util.tree_leaves(mod_vars.get("losses", {}))
         aux = sum(jnp.sum(a) for a in sown) if sown else jnp.zeros(())
+        # router-health scalars sown to 'metrics' (moe_overflow per layer);
+        # stashed for _logging — safe because forward() always runs
+        # _apply_model then _logging within one trace
+        over = jax.tree_util.tree_leaves(mod_vars.get("metrics", {}))
+        self._moe_logs = {
+            "moe_aux": jnp.sum(aux),
+            "moe_overflow": (
+                sum(jnp.mean(o) for o in over) / len(over)
+                if over else jnp.zeros(())
+            ),
+        }
         return out, self.moe_aux_loss_weight * aux
+
+    def _logging(self, loss, target, sample_size):
+        log = super()._logging(loss, target, sample_size)
+        # scaled by bsz so summing across micro-batches/hosts then dividing
+        # by total bsz in reduce_metrics recovers the mean fraction
+        for k, v in getattr(self, "_moe_logs", {}).items():
+            log[k] = v * log["bsz"]
+        return log
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        MaskedLMLoss.reduce_metrics(logging_outputs, split)
+        bsz = sum(log.get("bsz", 0) for log in logging_outputs)
+        if bsz > 0:
+            over = sum(log.get("moe_overflow", 0) for log in logging_outputs)
+            aux = sum(log.get("moe_aux", 0) for log in logging_outputs)
+            metrics.log_scalar("moe_overflow", over / bsz, 1, round=4)
+            metrics.log_scalar("moe_aux", aux / bsz, 1, round=4)
 
     @staticmethod
     def logging_outputs_can_be_summed(is_train) -> bool:

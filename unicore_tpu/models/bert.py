@@ -21,7 +21,10 @@ import jax.numpy as jnp
 
 from unicore_tpu import utils
 from unicore_tpu.models import register_model, register_model_architecture
-from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.models.unicore_model import (
+    BaseUnicoreModel,
+    strip_diagnostic_collections,
+)
 from unicore_tpu.modules import LayerNorm, TransformerEncoder, bert_init
 
 
@@ -99,6 +102,10 @@ class BertModel(BaseUnicoreModel):
     moe_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 2
+    # GPipe pipeline parallelism over the mesh 'pipe' axis
+    # (parallel/pipeline.py); 0 = off.  Set from --pipeline-parallel-size.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     @classmethod
     def add_args(cls, parser):
@@ -139,6 +146,11 @@ class BertModel(BaseUnicoreModel):
                                  "--moe-experts > 0")
         parser.add_argument("--moe-top-k", type=int,
                             help="experts per token")
+        parser.add_argument("--pipeline-microbatches", type=int,
+                            help="GPipe microbatches per update when "
+                                 "--pipeline-parallel-size > 1 (batch must "
+                                 "divide evenly; >= 4x stages keeps the "
+                                 "bubble under 20%%)")
 
     @classmethod
     def build_model(cls, args, task):
@@ -164,6 +176,11 @@ class BertModel(BaseUnicoreModel):
             moe_experts=getattr(args, "moe_experts", 0) or 0,
             moe_every=getattr(args, "moe_every", 2) or 2,
             moe_top_k=getattr(args, "moe_top_k", 2) or 2,
+            pipeline_stages=(
+                pp if (pp := getattr(args, "pipeline_parallel_size", 1)) > 1
+                else 0
+            ),
+            pipeline_microbatches=getattr(args, "pipeline_microbatches", 4) or 4,
         )
 
     def setup(self):
@@ -200,6 +217,8 @@ class BertModel(BaseUnicoreModel):
             moe_experts=self.moe_experts,
             moe_every=self.moe_every,
             moe_top_k=self.moe_top_k,
+            pipeline_stages=self.pipeline_stages,
+            pipeline_microbatches=self.pipeline_microbatches,
             name="sentence_encoder",
         )
         self.lm_head = BertLMHead(
@@ -253,9 +272,9 @@ class BertModel(BaseUnicoreModel):
 
     def init_params(self, rng, sample):
         src_tokens = jnp.asarray(sample["net_input"]["src_tokens"])
-        return self.init(
+        return strip_diagnostic_collections(self.init(
             {"params": rng, "dropout": rng}, src_tokens, train=False
-        )
+        ))
 
 
 @register_model_architecture("bert", "bert")

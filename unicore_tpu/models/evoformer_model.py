@@ -12,7 +12,10 @@ import jax.numpy as jnp
 
 from unicore_tpu import utils
 from unicore_tpu.models import register_model, register_model_architecture
-from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.models.unicore_model import (
+    BaseUnicoreModel,
+    strip_diagnostic_collections,
+)
 from unicore_tpu.modules import EvoformerStack, LayerNorm, bert_init
 from unicore_tpu.modules.transformer_encoder import make_rp_bucket
 
@@ -125,11 +128,11 @@ class EvoformerModel(BaseUnicoreModel):
         return logits, pair
 
     def init_params(self, rng, sample):
-        return self.init(
+        return strip_diagnostic_collections(self.init(
             {"params": rng, "dropout": rng},
             jnp.asarray(sample["net_input"]["src_msa"]),
             train=False,
-        )
+        ))
 
 
 @register_model_architecture("evoformer", "evoformer")

@@ -7,10 +7,61 @@ pure functions; parameters live outside the model in the TrainState pytree.
 produces the parameter pytree from a sample batch.
 """
 
+import contextlib
+import threading
 from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# in-model update-count hook (reference unicore_model.py:50-58)
+# ---------------------------------------------------------------------------
+# The reference pushes the optimizer step into every submodule via a
+# set_num_updates() recursion so models can run in-model schedules (annealed
+# losses, warmup-gated branches).  Mutating module state is not expressible
+# in jit, so the TPU-native shape of the same hook is a TRACE-TIME context:
+# the trainer wraps each compiled step's forward in
+# ``num_updates_context(step_scalar)`` where ``step_scalar`` is the in-jit
+# int32 step, and any module — at any depth, no threading through call
+# signatures — reads it with ``current_num_updates()``.  The value is a
+# traced scalar, so step changes never trigger recompilation.
+
+def strip_diagnostic_collections(variables):
+    """Drop sown/diagnostic flax collections from an ``init`` result so only
+    real parameters enter the TrainState.  Leaked sown entries would (a)
+    receive gradients and get optimizer-updated, corrupting e.g. the MoE aux
+    objective, and (b) accumulate alongside fresh sows at apply time,
+    contaminating logged values.  Every ``init_params`` must route its
+    ``init()`` output through here."""
+    return {
+        k: v for k, v in variables.items()
+        if k not in ("losses", "intermediates", "metrics")
+    }
+
+
+_schedule_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def num_updates_context(value):
+    """Make ``value`` (an in-jit int32 scalar) visible to every module's
+    forward during tracing.  Entered by the Trainer; user code only reads."""
+    prev = getattr(_schedule_ctx, "value", None)
+    _schedule_ctx.value = value
+    try:
+        yield
+    finally:
+        _schedule_ctx.value = prev
+
+
+def current_num_updates():
+    """The optimizer update count as an int32 scalar, usable inside any
+    module ``__call__`` for in-model schedules.  Zero outside a training
+    step (init, standalone apply)."""
+    value = getattr(_schedule_ctx, "value", None)
+    return jnp.zeros((), jnp.int32) if value is None else value
 
 
 class BaseUnicoreModel(nn.Module):
@@ -46,10 +97,12 @@ class BaseUnicoreModel(nn.Module):
         """
         net_input = sample["net_input"] if "net_input" in sample else sample
         variables = self.init({"params": rng, "dropout": rng}, **net_input)
-        return {
-            k: v for k, v in variables.items()
-            if k not in ("losses", "intermediates")
-        }
+        return strip_diagnostic_collections(variables)
+
+    def get_num_updates(self):
+        """In-model schedule hook: the current optimizer step (traced int32
+        scalar; see :func:`current_num_updates`)."""
+        return current_num_updates()
 
     def get_targets(self, sample, net_output):
         """Get targets from either the sample or the net's output."""

@@ -20,7 +20,10 @@ import jax.numpy as jnp
 
 from unicore_tpu import utils
 from unicore_tpu.models import register_model, register_model_architecture
-from unicore_tpu.models.unicore_model import BaseUnicoreModel
+from unicore_tpu.models.unicore_model import (
+    BaseUnicoreModel,
+    strip_diagnostic_collections,
+)
 from unicore_tpu.modules import LayerNorm, bert_init
 from unicore_tpu.modules.transformer_encoder_with_pair import (
     TransformerEncoderWithPair,
@@ -292,14 +295,14 @@ class UniMolModel(BaseUnicoreModel):
 
     def init_params(self, rng, sample):
         ni = sample["net_input"]
-        return self.init(
+        return strip_diagnostic_collections(self.init(
             {"params": rng, "dropout": rng},
             jnp.asarray(ni["src_tokens"]),
             jnp.asarray(ni["src_coord"]),
             jnp.asarray(ni["src_distance"]),
             jnp.asarray(ni["src_edge_type"]),
             train=False,
-        )
+        ))
 
 
 @register_model_architecture("unimol", "unimol")

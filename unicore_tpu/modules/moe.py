@@ -45,6 +45,14 @@ class MoELayer(nn.Module):
     activation_fn: str = "gelu"
     activation_dropout: float = 0.0
     router_jitter: float = 0.0  # multiplicative input noise during training
+    # 'scatter' (default): tokens scatter-add into the (E, cap, D) expert
+    # buffers and gather back out — peak extra memory is O(k·cap_total·D),
+    # the same order as the token activations themselves.  'dense': the
+    # one-hot einsum formulation, which materializes (k·N, E, cap) dispatch
+    # masks — O(k·N·E·cap) memory, quadratic-ish at scale (tens of GiB at
+    # N=32k, E=64); kept as the readable reference semantics and pinned to
+    # the scatter path by an equivalence test (tests/test_moe.py).
+    dispatch: str = "scatter"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -73,14 +81,17 @@ class MoELayer(nn.Module):
             gate_vals.sum(-1, keepdims=True), 1e-9
         )
 
-        # --- load-balance auxiliary loss (importance x load, scaled by E)
-        sel0 = jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
-        load = sel0.mean(0)          # fraction of tokens whose top-1 is e
-        importance = probs.mean(0)   # mean router probability of e
+        # --- load-balance auxiliary loss (importance x load, scaled by E).
+        # Load counts ALL k routed choices (GShard-style), matching the
+        # top-k routing above — a top-1-only load lets second choices pile
+        # onto one expert invisibly.
+        sel = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32).sum(1)  # (N, E)
+        load = sel.mean(0) / self.top_k  # fraction of routes landing on e
+        importance = probs.mean(0)       # mean router probability of e
         aux = E * jnp.sum(load * importance)
         self.sow("losses", "moe_aux", aux)
 
-        # --- capacity-bounded dense dispatch
+        # --- capacity-bounded routing positions
         cap = max(8, int(self.capacity_factor * self.top_k * N / E))
         # position of each (token, choice) within its expert's queue:
         # flatten choices in priority order (all top-1 first) so second
@@ -92,17 +103,13 @@ class MoELayer(nn.Module):
         pos = jnp.sum(pos * onehot, axis=-1)         # (kN,)
         keep = pos < cap
         flat_gate = jnp.where(keep, flat_gate, 0.0)
+        # router health: fraction of routes dropped by the capacity bound —
+        # without this, capacity starvation is invisible in the logs.  Sown
+        # to 'metrics' (not 'losses') so the aux-loss sum never includes it.
+        self.sow("metrics", "moe_overflow",
+                 1.0 - keep.astype(jnp.float32).mean())
 
-        # dispatch (kN, E, cap) built from two one-hots; combine = gated
-        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, cap), cap + 1,
-                                dtype=x.dtype)[..., :cap]  # (kN, cap)
-        disp = onehot.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
-        comb = disp.astype(jnp.float32) * flat_gate[:, None, None]
-        # fold the k choices back onto tokens
-        disp = disp.reshape(self.top_k, N, E, cap).sum(0)
-        comb = comb.reshape(self.top_k, N, E, cap).sum(0)
-
-        # --- expert computation: weights (E, ...) shard over 'expert'
+        # --- expert weights: (E, ...) shard over the 'expert' mesh axis
         w1 = self.param("experts_fc1", _router_init, (E, D, F), jnp.float32)
         b1 = self.param("experts_bias1", nn.initializers.zeros, (E, F),
                         jnp.float32)
@@ -111,7 +118,26 @@ class MoELayer(nn.Module):
                         jnp.float32)
         act = utils.get_activation_fn(self.activation_fn)
 
-        expert_in = jnp.einsum("nec,nd->ecd", disp, tokens)  # (E, cap, D)
+        if self.dispatch == "dense":
+            # reference semantics: (kN, E, cap) one-hot masks + einsums
+            pos_oh = jax.nn.one_hot(jnp.where(keep, pos, cap), cap + 1,
+                                    dtype=x.dtype)[..., :cap]  # (kN, cap)
+            disp = onehot.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
+            comb = disp.astype(jnp.float32) * flat_gate[:, None, None]
+            disp = disp.reshape(self.top_k, N, E, cap).sum(0)
+            comb = comb.reshape(self.top_k, N, E, cap).sum(0)
+            expert_in = jnp.einsum("nec,nd->ecd", disp, tokens)  # (E,cap,D)
+        else:
+            # scatter dispatch: each kept (token, choice) owns one unique
+            # slot expert*cap + pos; dropped routes land on a spare row that
+            # is sliced off.  No (.., E, cap) dense mask ever exists.
+            slot = jnp.where(keep, flat_idx * cap + pos, E * cap)  # (kN,)
+            tokens_rep = jnp.tile(tokens, (self.top_k, 1))  # choice-major
+            expert_in = (
+                jnp.zeros((E * cap + 1, D), x.dtype)
+                .at[slot].add(tokens_rep.astype(x.dtype))
+            )[:-1].reshape(E, cap, D)
+
         h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype))
         h = act(h + b1[:, None].astype(h.dtype))
         if train and self.activation_dropout > 0.0:
@@ -120,7 +146,16 @@ class MoELayer(nn.Module):
             )
         out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
         out_e = out_e + b2[:, None].astype(out_e.dtype)
-        out = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out_e)
+
+        if self.dispatch == "dense":
+            out = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out_e)
+        else:
+            out_flat = jnp.concatenate(
+                [out_e.reshape(E * cap, D),
+                 jnp.zeros((1, D), out_e.dtype)], axis=0,
+            )
+            gathered = out_flat[slot] * flat_gate[:, None].astype(out_e.dtype)
+            out = gathered.reshape(self.top_k, N, D).sum(0)
         return out.reshape(B, S, D)
 
 
@@ -140,6 +175,7 @@ class MoEEncoderLayer(nn.Module):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    dispatch: str = "scatter"
 
     @nn.compact
     def __call__(
@@ -188,6 +224,7 @@ class MoEEncoderLayer(nn.Module):
             num_experts=self.num_experts,
             top_k=self.top_k,
             capacity_factor=self.capacity_factor,
+            dispatch=self.dispatch,
             activation_fn=self.activation_fn,
             activation_dropout=self.activation_dropout,
             name="moe",

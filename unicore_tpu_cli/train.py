@@ -1,10 +1,12 @@
 #!/usr/bin/env python3
-"""Train a new model on one or across multiple TPU hosts
-(reference /root/reference/unicore_cli/train.py).
+"""Training entry point: epoch loop, validation cadence, stop handling.
 
-Same loop skeleton: epoch loop -> per-epoch train() with GroupedIterator for
-gradient accumulation -> validate_and_save with all stop conditions
-(--max-epoch, --max-update, --stop-time-hours, --stop-min-lr, --patience).
+Covers the same operator surface as the reference CLI
+(/root/reference/unicore_cli/train.py): gradient-accumulation grouping,
+mid-epoch and end-of-epoch save/validate cadence, early stopping on a
+validation metric, and the --max-epoch / --max-update / --stop-time-hours /
+--stop-min-lr / --patience stop knobs — driving the TPU Trainer's fused
+SPMD step instead of a torch DDP loop.
 """
 
 import logging
@@ -14,326 +16,353 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+_LOG_FIELDS = ("asctime", "levelname", "name", "message")
 logging.basicConfig(
-    format="%(asctime)s | %(levelname)s | %(name)s | %(message)s",
-    datefmt="%Y-%m-%d %H:%M:%S",
-    level=os.environ.get("LOGLEVEL", "INFO").upper(),
     stream=sys.stdout,
+    level=os.environ.get("LOGLEVEL", "INFO").upper(),
+    format=" | ".join(f"%({f})s" for f in _LOG_FIELDS),
+    datefmt="%Y-%m-%d %H:%M:%S",
 )
 logger = logging.getLogger("unicore_tpu_cli.train")
 
 
+class EarlyStopMonitor:
+    """Trips once the tracked validation metric fails to improve ``patience``
+    validations in a row.  A non-positive patience disables the monitor;
+    validations that produced no metric are ignored entirely."""
+
+    def __init__(self, patience: int, maximize: bool):
+        self.patience = patience
+        self.maximize = maximize
+        self.best: Optional[float] = None
+        self.strikes = 0
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        return value > self.best if self.maximize else value < self.best
+
+    def should_stop(self, value: Optional[float]) -> bool:
+        if value is None or self.patience <= 0:
+            return False
+        if self._improved(value):
+            self.best = value
+            self.strikes = 0
+            return False
+        self.strikes += 1
+        if self.strikes < self.patience:
+            return False
+        logger.info(
+            f"early stop: validation metric stagnant for {self.strikes} "
+            f"consecutive validations (patience {self.patience})"
+        )
+        return True
+
+
+class TrainSession:
+    """One training run: owns the trainer, the early-stop monitor, the
+    async checkpoint pool, and the save/validate cadence decisions."""
+
+    def __init__(self, args, trainer, task):
+        from unicore_tpu import checkpoint_utils
+
+        self.args = args
+        self.trainer = trainer
+        self.task = task
+        self.early_stop = EarlyStopMonitor(
+            args.patience, args.maximize_best_checkpoint_metric
+        )
+        self.copy_pool = (
+            checkpoint_utils.make_copy_pool() if args.async_checkpoint else None
+        )
+        self.valid_subsets = args.valid_subset.split(",")
+
+    # -- stop conditions ------------------------------------------------
+
+    def hard_stop_reason(self) -> Optional[str]:
+        """Unconditional stop checks (budget-style limits, checked every
+        inner step): update budget and wall-clock budget."""
+        n = self.trainer.get_num_updates()
+        if self.args.max_update and n >= self.args.max_update:
+            return f"num_updates: {n} hit --max-update ({self.args.max_update})"
+        if self.args.stop_time_hours > 0:
+            trained_h = self.trainer.cumulative_training_time() / 3600.0
+            if trained_h > self.args.stop_time_hours:
+                return (
+                    f"exceeded --stop-time-hours "
+                    f"({trained_h:.2f}h > {self.args.stop_time_hours}h)"
+                )
+        return None
+
+    def lr_floor_reached(self) -> bool:
+        if self.args.stop_min_lr <= -1:
+            return False
+        return self.trainer.get_lr() <= self.args.stop_min_lr
+
+    # -- save / validate cadence ----------------------------------------
+
+    @staticmethod
+    def _on_interval(count: int, every: int) -> bool:
+        return every > 0 and count > 0 and count % every == 0
+
+    def cadence(self, epoch: int, end_of_epoch: bool, stopping: bool):
+        """Decide (save?, validate?) for the current position in the run.
+
+        Saves happen at epoch boundaries (--save-interval epochs), every
+        --save-interval-updates mid-epoch (once past
+        --validate-after-updates), and always when stopping.  Validation
+        accompanies every mid-epoch save, happens at --validate-interval
+        epoch boundaries and every --validate-interval-updates, and always
+        when stopping — unless disabled outright."""
+        n = self.trainer.get_num_updates()
+        a = self.args
+        save = (
+            stopping
+            or (end_of_epoch and self._on_interval(epoch, a.save_interval))
+            or (
+                self._on_interval(n, a.save_interval_updates)
+                and n >= a.validate_after_updates
+            )
+        )
+        validate = not a.disable_validation and (
+            stopping
+            or (save and not end_of_epoch)
+            or (end_of_epoch and self._on_interval(epoch, a.validate_interval))
+            or self._on_interval(n, a.validate_interval_updates)
+        )
+        return save, validate
+
+    def checkpoint_and_validate(
+        self, epoch_itr, end_of_epoch: bool
+    ) -> Tuple[List[Optional[float]], bool]:
+        """The per-step bookkeeping tail: evaluate stop conditions, run
+        validation and/or write checkpoints per the cadence, and report
+        (validation losses, should_stop)."""
+        from unicore_tpu import checkpoint_utils
+
+        reason = self.hard_stop_reason()
+        if reason:
+            logger.info(f"stopping training: {reason}")
+        stopping = reason is not None
+
+        do_save, do_validate = self.cadence(
+            epoch_itr.epoch, end_of_epoch, stopping
+        )
+
+        valid_losses: List[Optional[float]] = [None]
+        if do_validate:
+            self.trainer.flush_metrics()
+            valid_losses = validate(
+                self.args, self.trainer, self.task, epoch_itr,
+                self.valid_subsets,
+            )
+
+        if self.early_stop.should_stop(valid_losses[0]):
+            stopping = True
+        if self.lr_floor_reached():
+            logger.info(
+                f"stopping training: lr {self.trainer.get_lr()} fell to "
+                f"--stop-min-lr ({self.args.stop_min_lr})"
+            )
+            stopping = True
+
+        if do_save or stopping:
+            checkpoint_utils.save_checkpoint(
+                self.args, self.trainer, epoch_itr, valid_losses[0],
+                self.copy_pool,
+            )
+        return valid_losses, stopping
+
+    def close(self):
+        if self.copy_pool is not None:
+            self.copy_pool.close()
+            self.copy_pool.join()
+
+
 def main(args) -> None:
-    from unicore_tpu import (
-        checkpoint_utils,
-        options,
-        tasks,
-        utils,
-    )
-    from unicore_tpu.data import iterators
+    from unicore_tpu import checkpoint_utils, tasks, utils
     from unicore_tpu.distributed import utils as distributed_utils
-    from unicore_tpu.logging import meters, metrics, progress_bar
+    from unicore_tpu.logging import metrics
     from unicore_tpu.trainer import Trainer
 
     utils.import_user_module(args)
 
-    assert (
-        args.batch_size is not None
-    ), "Must specify batch size either with --batch-size"
+    assert args.batch_size is not None, (
+        "Must specify batch size either with --batch-size"
+    )
+    assert args.loss, "Please specify loss to train a model"
 
     metrics.reset()
 
-    import numpy as np
     import jax
+    import numpy as np
 
     np.random.seed(args.seed)
-
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
 
     if distributed_utils.is_master(args):
-        checkpoint_utils.verify_checkpoint_directory(args.save_dir)
-        checkpoint_utils.verify_checkpoint_directory(args.tmp_save_dir)
+        for d in (args.save_dir, args.tmp_save_dir):
+            checkpoint_utils.verify_checkpoint_directory(d)
 
     logger.info(args)
 
-    # Setup task, e.g., molecule pretraining
     task = tasks.setup_task(args)
-
-    assert args.loss, "Please specify loss to train a model"
-
-    # Build model and loss
     model = task.build_model(args)
     loss = task.build_loss(args)
-    logger.info(f"task: {task.__class__.__name__}")
-    logger.info(f"model: {model.__class__.__name__}")
-    logger.info(f"loss: {loss.__class__.__name__}")
+    for label, obj in (("task", task), ("model", model), ("loss", loss)):
+        logger.info(f"{label}: {obj.__class__.__name__}")
 
-    # Build trainer
     trainer = Trainer(args, task, model, loss)
     logger.info(
         f"training on {jax.device_count()} devices across "
         f"{jax.process_count()} hosts"
     )
 
-    # Load the latest checkpoint if one is available and restore the
-    # corresponding train iterator
     task.load_dataset(args.train_subset, combine=False, epoch=1)
-    extra_state, epoch_itr = load_checkpoint(args, trainer)
+    extra_state, epoch_itr = restore_session(args, trainer)
 
     if args.tensorboard_logdir and distributed_utils.is_master(args):
         os.makedirs(args.tensorboard_logdir, exist_ok=True)
 
-    max_epoch = args.max_epoch or math.inf
-    lr = trainer.get_lr()
-    train_meter = meters.StopwatchMeter()
-    train_meter.start()
+    session = TrainSession(args, trainer, task)
+    last_epoch = args.max_epoch or math.inf
 
-    ckp_copy_thread = checkpoint_utils.make_copy_pool() if args.async_checkpoint else None
-
-    profiler_started = False
-    if getattr(args, "profile", False):
-        import jax.profiler
-
+    profiling = bool(getattr(args, "profile", False))
+    if profiling:
         jax.profiler.start_trace(
-            os.path.join(args.save_dir, "jax_trace"), create_perfetto_link=False
+            os.path.join(args.save_dir, "jax_trace"),
+            create_perfetto_link=False,
         )
-        profiler_started = True
 
+    started = time.time()
     try:
-        while epoch_itr.next_epoch_idx <= max_epoch:
-            # train for one epoch
-            valid_losses, should_stop = train(
-                args, trainer, task, epoch_itr, ckp_copy_thread
-            )
-            if should_stop:
+        while epoch_itr.next_epoch_idx <= last_epoch:
+            valid_losses, stop = train_epoch(args, session, epoch_itr)
+            if stop:
                 break
-
-            # only use first validation loss to update the learning rate
-            lr = trainer.lr_step(epoch_itr.epoch, valid_losses[0])
-
+            # epoch-level lr schedules key off the FIRST subset's metric
+            trainer.lr_step(epoch_itr.epoch, valid_losses[0])
             epoch_itr = trainer.get_train_iterator(
                 epoch_itr.next_epoch_idx,
                 load_dataset=task.has_sharded_data("train"),
                 disable_iterator_cache=False,
             )
     finally:
-        if profiler_started:
-            import jax.profiler
-
+        if profiling:
             jax.profiler.stop_trace()
-        if ckp_copy_thread is not None:
-            ckp_copy_thread.close()
-            ckp_copy_thread.join()
+        session.close()
 
-    train_meter.stop()
-    logger.info(f"done training in {train_meter.sum:.1f} seconds")
+    logger.info(f"done training in {time.time() - started:.1f} seconds")
 
 
-def load_checkpoint(args, trainer):
+def restore_session(args, trainer):
+    """Load the latest checkpoint (if any) and position the epoch iterator
+    where the saved run left off."""
     from unicore_tpu import checkpoint_utils
 
     extra_state = checkpoint_utils.load_checkpoint(args, trainer)
-    # restore iterator position
-    if (
-        extra_state is not None
-        and "train_iterator" in extra_state
-        and not args.reset_dataloader
-    ):
-        itr_state = extra_state["train_iterator"]
+    saved_itr = (
+        (extra_state or {}).get("train_iterator")
+        if not args.reset_dataloader
+        else None
+    )
+    if saved_itr is not None:
         epoch_itr = trainer.get_train_iterator(
-            epoch=itr_state["epoch"], load_dataset=False
+            epoch=saved_itr["epoch"], load_dataset=False
         )
-        epoch_itr.load_state_dict(itr_state)
+        epoch_itr.load_state_dict(saved_itr)
     else:
         epoch_itr = trainer.get_train_iterator(epoch=1, load_dataset=False)
     trainer.maybe_init_from_iterator(epoch_itr)
     return extra_state, epoch_itr
 
 
-def should_stop_early(args, valid_loss: Optional[float]) -> bool:
-    # skip check if no validation was done in the current epoch
-    if valid_loss is None:
-        return False
-    if args.patience <= 0:
-        return False
-
-    def is_better(a, b):
-        return a > b if args.maximize_best_checkpoint_metric else a < b
-
-    prev_best = getattr(should_stop_early, "best", None)
-    if prev_best is None or is_better(valid_loss, prev_best):
-        should_stop_early.best = valid_loss
-        should_stop_early.num_runs = 0
-        return False
-    else:
-        should_stop_early.num_runs += 1
-        if should_stop_early.num_runs >= args.patience:
-            logger.info(
-                "early stop since valid performance hasn't improved for "
-                f"last {args.patience} runs"
-            )
-        return should_stop_early.num_runs >= args.patience
-
-
-def train(args, trainer, task, epoch_itr, ckp_copy_thread):
-    """Train the model for one epoch and return validation losses."""
+def train_epoch(args, session, epoch_itr):
+    """Run one epoch of updates; returns (valid_losses, should_stop)."""
     from unicore_tpu.data import iterators
     from unicore_tpu.distributed import utils as distributed_utils
-    from unicore_tpu.logging import metrics, progress_bar
+    from unicore_tpu.logging import metrics
+
+    trainer, task = session.trainer, session.task
 
     with metrics.aggregate(name="train"):
-        # Initialize data iterator
+        epoch = epoch_itr.epoch
         itr = epoch_itr.next_epoch_itr(
             fix_batches_to_gpus=args.fix_batches_to_gpus,
             shuffle=(epoch_itr.next_epoch_idx > args.curriculum),
         )
-        update_freq = (
-            args.update_freq[epoch_itr.epoch - 1]
-            if epoch_itr.epoch <= len(args.update_freq)
-            else args.update_freq[-1]
-        )
+        # --update-freq may vary per epoch; past the schedule's end the last
+        # entry applies
+        uf_schedule = args.update_freq
+        update_freq = uf_schedule[min(epoch, len(uf_schedule)) - 1]
         itr = iterators.GroupedIterator(itr, update_freq)
-        progress = progress_bar.progress_bar(
-            itr,
-            log_format=args.log_format,
-            log_interval=args.log_interval,
-            epoch=epoch_itr.epoch,
-            tensorboard_logdir=(
-                args.tensorboard_logdir if distributed_utils.is_master(args) else None
-            ),
-            default_log_format=("tqdm" if not args.no_progress_bar else "simple"),
+
+        progress = _make_progress(
+            args, itr, epoch,
             wandb_project=(
-                args.wandb_project if distributed_utils.is_master(args) else None
+                args.wandb_project
+                if distributed_utils.is_master(args)
+                else None
             ),
             wandb_name=args.wandb_name,
         )
 
-        trainer.begin_epoch(epoch_itr.epoch)
-
-        valid_subsets = args.valid_subset.split(",")
-        should_stop = False
+        trainer.begin_epoch(epoch)
+        valid_losses, stop = [None], False
         num_updates = trainer.get_num_updates()
-        for i, samples in enumerate(progress):
+
+        for grouped_samples in progress:
             with metrics.aggregate("train_inner"):
-                log_output = trainer.train_step(samples)
+                step_ok = trainer.train_step(grouped_samples) is not None
                 num_updates = trainer.get_num_updates()
-                if num_updates % args.log_interval == 0:
-                    # one device fetch per interval; inside the train_inner
-                    # context so the sums land in this aggregator too
+                at_log_point = num_updates % args.log_interval == 0
+                if at_log_point:
+                    # one device fetch per interval, inside the train_inner
+                    # scope so the sums land in this aggregator
                     trainer.flush_metrics()
 
-            if log_output is not None:  # not OOM, overflow, ...
-                # log mid-epoch stats
-                if num_updates % args.log_interval == 0:
-                    stats = get_training_stats(
-                        metrics.get_smoothed_values("train_inner")
-                    )
-                    progress.log(stats, tag="train_inner", step=num_updates)
+            if step_ok and at_log_point:
+                progress.log(
+                    _with_wall(metrics.get_smoothed_values("train_inner")),
+                    tag="train_inner", step=num_updates,
+                )
+                # interval stats restart here; the epoch aggregate above
+                # keeps accumulating independently
+                metrics.reset_meters("train_inner")
 
-                    # reset mid-epoch stats after each log interval
-                    # the end-of-epoch stats will still be preserved
-                    metrics.reset_meters("train_inner")
-
-            end_of_epoch = not itr.has_next()
-            valid_losses, should_stop = validate_and_save(
-                args,
-                trainer,
-                task,
-                epoch_itr,
-                valid_subsets,
-                end_of_epoch,
-                ckp_copy_thread,
+            valid_losses, stop = session.checkpoint_and_validate(
+                epoch_itr, end_of_epoch=not itr.has_next()
             )
-
-            if should_stop:
+            if stop:
                 break
 
-    # log end-of-epoch stats
-    logger.info(f"end of epoch {epoch_itr.epoch} (average epoch stats below)")
+    logger.info(f"end of epoch {epoch} (average epoch stats below)")
     trainer.flush_metrics()
-    stats = get_training_stats(metrics.get_smoothed_values("train"))
-    progress.print(stats, tag="train", step=num_updates)
-
-    # reset epoch-level meters
-    metrics.reset_meters("train")
-    return valid_losses, should_stop
-
-
-def validate_and_save(
-    args, trainer, task, epoch_itr, valid_subsets, end_of_epoch, ckp_copy_thread
-) -> Tuple[List[Optional[float]], bool]:
-    from unicore_tpu import checkpoint_utils
-
-    num_updates = trainer.get_num_updates()
-    max_update = args.max_update or math.inf
-
-    # Stopping conditions (and an additional one based on validation loss later
-    # on)
-    should_stop = False
-    if num_updates >= max_update:
-        should_stop = True
-        logger.info(
-            f"Stopping training due to "
-            f"num_updates: {num_updates} >= max_update: {max_update}"
-        )
-
-    training_time_hours = trainer.cumulative_training_time() / (60 * 60)
-    if args.stop_time_hours > 0 and training_time_hours > args.stop_time_hours:
-        should_stop = True
-        logger.info(
-            f"Stopping training due to "
-            f"cumulative_training_time: {training_time_hours} > "
-            f"stop_time_hours: {args.stop_time_hours} hour(s)"
-        )
-
-    do_save = (
-        (end_of_epoch and epoch_itr.epoch % args.save_interval == 0)
-        or should_stop
-        or (
-            args.save_interval_updates > 0
-            and num_updates > 0
-            and num_updates % args.save_interval_updates == 0
-            and num_updates >= args.validate_after_updates
-        )
+    progress.print(
+        _with_wall(metrics.get_smoothed_values("train")),
+        tag="train", step=num_updates,
     )
-    do_validate = (
-        (not end_of_epoch and do_save)  # validate during mid-epoch saves
-        or (end_of_epoch and epoch_itr.epoch % args.validate_interval == 0)
-        or should_stop
-        or (
-            args.validate_interval_updates > 0
-            and num_updates > 0
-            and num_updates % args.validate_interval_updates == 0
-        )
-    ) and not args.disable_validation
-
-    # Validate
-    valid_losses = [None]
-    if do_validate:
-        trainer.flush_metrics()
-        valid_losses = validate(args, trainer, task, epoch_itr, valid_subsets)
-
-    should_stop |= should_stop_early(args, valid_losses[0])
-
-    # Stopping condition on minimum lr
-    if args.stop_min_lr > -1 and trainer.get_lr() <= args.stop_min_lr:
-        should_stop = True
-        logger.info(
-            f"Stopping training due to lr: {trainer.get_lr()} <= "
-            f"stop-min-lr: {args.stop_min_lr}"
-        )
-
-    # Save checkpoint
-    if do_save or should_stop:
-        checkpoint_utils.save_checkpoint(
-            args, trainer, epoch_itr, valid_losses[0], ckp_copy_thread
-        )
-
-    return valid_losses, should_stop
+    metrics.reset_meters("train")
+    return valid_losses, stop
 
 
-def get_training_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
+def _make_progress(args, itr, epoch, **extra):
+    """Progress/logging wrapper around a batch iterator; tensorboard output
+    only from the master host."""
+    from unicore_tpu.distributed import utils as distributed_utils
+    from unicore_tpu.logging import progress_bar
+
+    tb_dir = args.tensorboard_logdir if distributed_utils.is_master(args) else None
+    fmt = "simple" if args.no_progress_bar else "tqdm"
+    return progress_bar.progress_bar(
+        itr, log_format=args.log_format, log_interval=args.log_interval,
+        epoch=epoch, tensorboard_logdir=tb_dir, default_log_format=fmt,
+        **extra,
+    )
+
+
+def _with_wall(stats: Dict[str, Any]) -> Dict[str, Any]:
     from unicore_tpu.logging import metrics
 
     stats["wall"] = round(metrics.get_meter("default", "wall").elapsed_time, 0)
@@ -341,75 +370,63 @@ def get_training_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def validate(args, trainer, task, epoch_itr, subsets: List[str]) -> List[Optional[float]]:
-    """Evaluate the model on the validation set(s) and return the losses."""
-    from unicore_tpu.data import iterators
-    from unicore_tpu.distributed import utils as distributed_utils
-    from unicore_tpu.logging import metrics, progress_bar
+    """Evaluate on each validation subset; returns one metric per subset.
 
-    seed = None
-    if args.fixed_validation_seed is not None:
-        # set fixed seed for every validation
-        seed = args.fixed_validation_seed
+    Per-batch logging outputs accumulate ON DEVICE (trainer.valid_step with
+    ``accumulate=True``); the host fetches the summed totals once per
+    subset instead of once per batch."""
+    from unicore_tpu.logging import metrics
+
+    fixed_seed = args.fixed_validation_seed  # None -> step-keyed eval rng
 
     trainer.begin_valid_epoch(epoch_itr.epoch)
-    valid_losses = []
+    results = []
     for subset in subsets:
         logger.info(f'begin validation on "{subset}" subset')
-
-        # Initialize data iterator
         if subset not in task.datasets:
             task.load_dataset(subset, combine=False, epoch=1)
         itr = trainer.get_valid_iterator(subset).next_epoch_itr(shuffle=False)
-        progress = progress_bar.progress_bar(
-            itr,
-            log_format=args.log_format,
-            log_interval=args.log_interval,
-            epoch=epoch_itr.epoch,
-            prefix=f"valid on '{subset}' subset",
-            tensorboard_logdir=(
-                args.tensorboard_logdir if distributed_utils.is_master(args) else None
-            ),
-            default_log_format=("tqdm" if not args.no_progress_bar else "simple"),
+        progress = _make_progress(
+            args, itr, epoch_itr.epoch, prefix=f"valid on '{subset}' subset"
         )
 
-        # create a new root metrics aggregator so validation metrics
-        # don't pollute other aggregators (e.g., train meters)
+        # separate metrics root: validation must not bleed into train meters
         with metrics.aggregate(new_root=True) as agg:
-            logging_outputs = []
             for i, sample in enumerate(progress):
-                if (
-                    args.max_valid_steps is not None
-                    and i > args.max_valid_steps
-                ):
+                if args.max_valid_steps is not None and i > args.max_valid_steps:
                     break
-                logging_outputs.append(trainer.valid_step(sample, seed=seed))
-            task.reduce_metrics(logging_outputs, trainer.loss, subset)
+                trainer.valid_step(sample, seed=fixed_seed, accumulate=True)
+            totals = trainer.finish_valid_accum()
+            task.reduce_metrics([totals] if totals else [], trainer.loss, subset)
 
-        # log validation stats
-        stats = get_valid_stats(args, trainer, agg.get_smoothed_values())
+        stats = _finalize_valid_stats(args, trainer, agg.get_smoothed_values())
         progress.print(stats, tag=subset, step=trainer.get_num_updates())
+        results.append(stats.get(args.best_checkpoint_metric, None))
+    return results
 
-        valid_losses.append(stats.get(args.best_checkpoint_metric, None))
-    return valid_losses
 
-
-def get_valid_stats(args, trainer, stats: Dict[str, Any]) -> Dict[str, Any]:
+def _finalize_valid_stats(args, trainer, stats: Dict[str, Any]) -> Dict[str, Any]:
     from unicore_tpu import checkpoint_utils
 
     stats["num_updates"] = trainer.get_num_updates()
-    if hasattr(checkpoint_utils.save_checkpoint, "best") and (
-        args.best_checkpoint_metric in stats
-    ):
-        key = f"best_{args.best_checkpoint_metric}"
-        best_function = max if args.maximize_best_checkpoint_metric else min
-        stats[key] = best_function(
-            checkpoint_utils.save_checkpoint.best,
-            stats[args.best_checkpoint_metric],
-        )
+    metric = args.best_checkpoint_metric
+    best_so_far = checkpoint_utils.best_score()
+    if best_so_far is not None and metric in stats:
+        pick = max if args.maximize_best_checkpoint_metric else min
+        stats[f"best_{metric}"] = pick(best_so_far, stats[metric])
     return stats
 
 
 def cli_main(modify_parser: Optional[Callable] = None) -> None:
+    # UNICORE_TPU_PLATFORM=cpu forces the virtual-CPU mesh BEFORE any jax
+    # backend init (UNICORE_TPU_CPU_DEVICES sets its size, default 8) —
+    # lets the example scripts and smoke runs proceed when no accelerator
+    # is reachable; see platform_utils for why JAX_PLATFORMS alone fails.
+    if os.environ.get("UNICORE_TPU_PLATFORM", "").lower() == "cpu":
+        from unicore_tpu.platform_utils import force_host_cpu
+
+        force_host_cpu(int(os.environ.get("UNICORE_TPU_CPU_DEVICES", "8")))
+
     from unicore_tpu import options
     from unicore_tpu.distributed import utils as distributed_utils
 
