@@ -9,6 +9,9 @@ seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
     BENCH_CONFIG=bert       (default) BERT-base MLM, samples/s/chip
     BENCH_CONFIG=unimol     Uni-Mol pair-bias pretraining step
     BENCH_CONFIG=evoformer  Evoformer masked-MSA step
+    BENCH_CONFIG=moe        BERT-base with a top-2 routed expert FFN every
+                            other layer (BENCH_MOE_EXPERTS, default 8) —
+                            times the scatter dispatch path
     BENCH_CONFIG=all        run every config; one JSON line each, failures
                             in one config don't lose the others' results
 
@@ -210,6 +213,32 @@ def _build_config(config, args, batch_size, seq_len):
             ).astype(np.int64),
         }
         metric = f"evoformer_masked_msa_bf16_L{seq_len}_samples_per_sec_per_chip"
+    elif config == "moe":
+        # BERT-base body with a top-2 routed expert FFN every other layer —
+        # times the scatter dispatch path (modules/moe.py) end to end
+        E = int(os.environ.get("BENCH_MOE_EXPERTS", "8"))
+        model = BertModel(
+            vocab_size=vocab,
+            padding_idx=1,
+            encoder_layers=12,
+            encoder_embed_dim=768,
+            encoder_ffn_embed_dim=3072,
+            encoder_attention_heads=12,
+            max_seq_len=seq_len,
+            post_ln=True,
+            moe_experts=E,
+            moe_every=2,
+            moe_top_k=2,
+        )
+        loss = LOSS_REGISTRY["masked_lm_moe"](task, moe_aux_loss_weight=0.01)
+        tokens = rng.randint(4, vocab, size=(batch_size, seq_len)).astype(np.int64)
+        target = np.where(rng.rand(batch_size, seq_len) < 0.15, tokens, 1).astype(
+            np.int64
+        )
+        sample = {"net_input": {"src_tokens": tokens}, "target": target}
+        metric = (
+            f"bert_base_moe{E}_top2_bf16_seq{seq_len}_samples_per_sec_per_chip"
+        )
     else:
         raise ValueError(f"unknown BENCH_CONFIG {config}")
     return model, loss, task, sample, metric
@@ -347,8 +376,12 @@ def run_config(config):
 
     from unicore_tpu.trainer import Trainer
 
-    batch_size = int(os.environ.get("BENCH_BATCH", "64" if config == "bert" else "8"))
-    seq_len = int(os.environ.get("BENCH_SEQ", "512" if config == "bert" else "256"))
+    batch_size = int(os.environ.get(
+        "BENCH_BATCH", "64" if config in ("bert", "moe") else "8"
+    ))
+    seq_len = int(os.environ.get(
+        "BENCH_SEQ", "512" if config in ("bert", "moe") else "256"
+    ))
     warmup, iters = 3, 10
 
     args = _make_args()
@@ -508,7 +541,10 @@ def main():
         print(json.dumps(run_pipeline_bench()))
         return
     config = os.environ.get("BENCH_CONFIG", "bert")
-    configs = ["bert", "unimol", "evoformer"] if config == "all" else [config]
+    configs = (
+        ["bert", "unimol", "evoformer", "moe"] if config == "all"
+        else [config]
+    )
     ok = False
     for c in configs:
         try:

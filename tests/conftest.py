@@ -22,6 +22,7 @@ force_host_cpu(8)
 # ---------------------------------------------------------------------------
 
 _FAST_FILES = {
+    "test_cli_session.py",
     "test_data.py",
     "test_logging.py",
     "test_optim.py",

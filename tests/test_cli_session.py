@@ -1,0 +1,137 @@
+"""Unit tests for the CLI orchestration logic (unicore_tpu_cli/train.py):
+EarlyStopMonitor and the TrainSession save/validate cadence.  These pin the
+reference's stop/cadence semantics (reference unicore_cli/train.py:149-174,
+251-329) without paying for an end-to-end subprocess run — the e2e suite
+(test_e2e_train.py) covers the wiring."""
+
+from argparse import Namespace
+
+from unicore_tpu_cli.train import EarlyStopMonitor, TrainSession
+
+
+# ---------------------------------------------------------------------------
+# EarlyStopMonitor
+# ---------------------------------------------------------------------------
+
+def test_early_stop_disabled_by_nonpositive_patience():
+    m = EarlyStopMonitor(patience=0, maximize=False)
+    assert not any(m.should_stop(v) for v in (3.0, 4.0, 5.0, 6.0))
+    m = EarlyStopMonitor(patience=-1, maximize=False)
+    assert not m.should_stop(1.0)
+
+
+def test_early_stop_counts_consecutive_stagnation():
+    m = EarlyStopMonitor(patience=2, maximize=False)
+    assert not m.should_stop(5.0)   # first value is the baseline
+    assert not m.should_stop(4.0)   # improvement resets
+    assert not m.should_stop(4.5)   # strike 1
+    assert m.should_stop(4.4)       # strike 2 -> trip (4.4 > best 4.0? no,
+    # 4.4 is worse than 4.0 under minimize, so it is a strike)
+
+
+def test_early_stop_improvement_resets_strikes():
+    m = EarlyStopMonitor(patience=2, maximize=False)
+    m.should_stop(5.0)
+    m.should_stop(5.5)              # strike 1
+    assert not m.should_stop(4.0)   # improvement clears strikes
+    m.should_stop(4.2)              # strike 1 again
+    assert m.should_stop(4.1)       # strike 2 -> trip
+
+
+def test_early_stop_maximize_direction():
+    m = EarlyStopMonitor(patience=1, maximize=True)
+    assert not m.should_stop(0.5)
+    assert not m.should_stop(0.7)   # higher is better
+    assert m.should_stop(0.6)       # worse -> single-strike trip
+
+
+def test_early_stop_ignores_missing_metric():
+    m = EarlyStopMonitor(patience=1, maximize=False)
+    m.should_stop(5.0)
+    assert not m.should_stop(None)  # no metric: not a strike
+    assert m.should_stop(6.0)
+
+
+# ---------------------------------------------------------------------------
+# TrainSession cadence
+# ---------------------------------------------------------------------------
+
+class _FakeTrainer:
+    def __init__(self, n):
+        self._n = n
+
+    def get_num_updates(self):
+        return self._n
+
+    def cumulative_training_time(self):
+        return 0.0
+
+    def get_lr(self):
+        return 1e-4
+
+
+def _session(n_updates, **overrides):
+    defaults = dict(
+        patience=-1, maximize_best_checkpoint_metric=False,
+        async_checkpoint=False, valid_subset="valid",
+        max_update=0, stop_time_hours=0, stop_min_lr=-1,
+        save_interval=1, save_interval_updates=0,
+        validate_interval=1, validate_interval_updates=0,
+        validate_after_updates=0, disable_validation=False,
+    )
+    defaults.update(overrides)
+    args = Namespace(**defaults)
+    return TrainSession(args, _FakeTrainer(n_updates), task=None)
+
+
+def test_cadence_end_of_epoch_saves_and_validates():
+    s = _session(10)
+    assert s.cadence(epoch=1, end_of_epoch=True, stopping=False) == (True, True)
+
+
+def test_cadence_save_interval_epochs():
+    s = _session(10, save_interval=2, validate_interval=2)
+    assert s.cadence(1, True, False) == (False, False)
+    assert s.cadence(2, True, False) == (True, True)
+
+
+def test_cadence_mid_epoch_interval_updates():
+    s = _session(200, save_interval_updates=100)
+    save, validate = s.cadence(1, False, False)
+    assert save and validate  # mid-epoch save brings validation with it
+    s = _session(150, save_interval_updates=100)
+    assert s.cadence(1, False, False) == (False, False)
+
+
+def test_cadence_validate_after_updates_gates_midepoch_saves():
+    s = _session(100, save_interval_updates=100, validate_after_updates=500)
+    assert s.cadence(1, False, False) == (False, False)
+    s = _session(600, save_interval_updates=100, validate_after_updates=500)
+    assert s.cadence(1, False, False) == (True, True)
+
+
+def test_cadence_validate_interval_updates_without_save():
+    s = _session(50, validate_interval_updates=50)
+    assert s.cadence(1, False, False) == (False, True)
+
+
+def test_cadence_stopping_forces_both():
+    s = _session(3, save_interval=100, validate_interval=100)
+    assert s.cadence(1, False, True) == (True, True)
+
+
+def test_cadence_disable_validation_wins():
+    s = _session(10, disable_validation=True)
+    save, validate = s.cadence(1, True, True)
+    assert save and not validate
+
+
+def test_hard_stop_max_update_and_lr_floor():
+    s = _session(10, max_update=10)
+    assert "max-update" in s.hard_stop_reason()
+    s = _session(9, max_update=10)
+    assert s.hard_stop_reason() is None
+    s = _session(1, stop_min_lr=1e-3)
+    assert s.lr_floor_reached()  # fake lr 1e-4 <= 1e-3
+    s = _session(1)  # stop_min_lr -1: disabled
+    assert not s.lr_floor_reached()
