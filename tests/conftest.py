@@ -14,6 +14,21 @@ from unicore_tpu.platform_utils import force_host_cpu
 
 force_host_cpu(8)
 
+# Persistent XLA compile cache for the whole suite (same idea as the e2e
+# RUNNER's): a 1-core box spends most of the suite in XLA — reruns skip it.
+# Disable with UNICORE_TPU_TEST_JAX_CACHE=0.
+_cache = os.environ.get(
+    "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_test_jaxcache"
+)
+if _cache != "0":
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
 # ---------------------------------------------------------------------------
 # `-m fast` smoke subset: finishes in ~1 minute on one CPU core, touching
 # data pipeline, logging, optim/schedulers, checkpointing, kernels (jnp

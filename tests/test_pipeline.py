@@ -178,3 +178,232 @@ def test_trainer_pp2_end_to_end(mesh):
     assert stacked, "no pipeline_stack params in TrainState"
     spec = stacked[0].sharding.spec
     assert "pipe" in str(spec), spec
+
+
+# ---------------------------------------------------------------------------
+# Evoformer pipeline (the deep stack PP was built for)
+# ---------------------------------------------------------------------------
+
+EB, ER, EL = 8, 4, 16  # batch, MSA rows, residues
+EBLOCKS, ESTAGES, EMICRO = 4, 2, 2
+
+
+def _evo_stack(pipeline: bool):
+    from unicore_tpu.modules import EvoformerStack
+
+    return EvoformerStack(
+        num_blocks=EBLOCKS,
+        msa_dim=32,
+        pair_dim=16,
+        msa_heads=4,
+        pair_heads=2,
+        dropout=0.0,
+        remat=False,
+        pipeline_stages=ESTAGES if pipeline else 0,
+        pipeline_microbatches=EMICRO,
+    )
+
+
+def test_evoformer_pipeline_matches_plain(mesh):
+    """Pipelined EvoformerStack == plain block loop, forward and param
+    gradients, on a dp x pp mesh — both streams (msa, pair) ride the ring."""
+    r = np.random.RandomState(0)
+    msa = r.randn(EB, ER, EL, 32).astype(np.float32)
+    pair = r.randn(EB, EL, EL, 16).astype(np.float32)
+
+    pipe = _evo_stack(True)
+    plain = _evo_stack(False)
+    p_pipe = pipe.init(jax.random.key(0), jnp.asarray(msa),
+                       jnp.asarray(pair))["params"]
+    # perturb ALL params away from init (zero-init out_proj etc. would hide
+    # scaling bugs that only show with non-zero weights)
+    leaves, treedef = jax.tree_util.tree_flatten(p_pipe)
+    keys = jax.random.split(jax.random.key(7), len(leaves))
+    p_pipe = jax.tree_util.tree_unflatten(treedef, [
+        l + 0.02 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)
+    ])
+    p_plain_init = plain.init(jax.random.key(1), jnp.asarray(msa),
+                              jnp.asarray(pair))["params"]
+    p_plain = dict(p_plain_init)
+    for i in range(EBLOCKS):
+        p_plain[f"block_{i}"] = jax.tree_util.tree_map(
+            lambda s, i=i: s[i], p_pipe["pipeline_stack"]
+        )
+
+    m1, z1 = pipe.apply({"params": p_pipe}, msa, pair)
+    m2, z2 = plain.apply({"params": p_plain}, msa, pair)
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(z1), np.asarray(z2),
+                               atol=1e-4, rtol=1e-4)
+
+    def loss_pipe(p):
+        m, z = pipe.apply({"params": p}, msa, pair)
+        return jnp.sum(m * m) + jnp.sum(z * z)
+
+    def loss_plain(p):
+        m, z = plain.apply({"params": p}, msa, pair)
+        return jnp.sum(m * m) + jnp.sum(z * z)
+
+    g_pipe = jax.grad(loss_pipe)(p_pipe)
+    g_plain = jax.grad(loss_plain)(p_plain)
+    for i in range(EBLOCKS):
+        want = jax.tree_util.tree_leaves(g_plain[f"block_{i}"])
+        got = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda s, i=i: s[i],
+                                   g_pipe["pipeline_stack"])
+        )
+        assert len(want) == len(got)
+        for w, g in zip(want, got):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-4, rtol=5e-4)
+
+
+def test_pair_encoder_pipeline_matches_plain(mesh):
+    """Pipelined TransformerEncoderWithPair (Uni-Mol backbone) == plain
+    loop: the evolved pair bias must ride the ring between stages."""
+    from unicore_tpu.modules.transformer_encoder_with_pair import (
+        TransformerEncoderWithPair,
+    )
+
+    PB, PL, PD, PH = 8, 16, 32, 4
+
+    def enc(pipeline):
+        return TransformerEncoderWithPair(
+            encoder_layers=4, embed_dim=PD, ffn_embed_dim=2 * PD,
+            attention_heads=PH, emb_dropout=0.0, dropout=0.0,
+            attention_dropout=0.0, activation_dropout=0.0, max_seq_len=PL,
+            pipeline_stages=2 if pipeline else 0, pipeline_microbatches=2,
+        )
+
+    r = np.random.RandomState(0)
+    emb = r.randn(PB, PL, PD).astype(np.float32)
+    bias = r.randn(PB, PH, PL, PL).astype(np.float32)
+
+    pipe, plain = enc(True), enc(False)
+    p_pipe = pipe.init(jax.random.key(0), jnp.asarray(emb),
+                       jnp.asarray(bias))["params"]
+    p_plain = dict(
+        plain.init(jax.random.key(1), jnp.asarray(emb),
+                   jnp.asarray(bias))["params"]
+    )
+    for i in range(4):
+        p_plain[f"layers_{i}"] = jax.tree_util.tree_map(
+            lambda s, i=i: s[i], p_pipe["pipeline_stack"]
+        )
+    for shared in ("emb_layer_norm", "final_layer_norm",
+                   "final_head_layer_norm"):
+        if shared in p_pipe:
+            p_plain[shared] = p_pipe[shared]
+
+    o_pipe = pipe.apply({"params": p_pipe}, emb, bias)
+    o_plain = plain.apply({"params": p_plain}, emb, bias)
+    # (x, pair_rep, delta, x_norm, delta_norm) — all five must agree
+    for a, b in zip(o_pipe, o_plain):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+    def loss(enc_, p):
+        x, pr, dl, xn, dn = enc_.apply({"params": p}, emb, bias)
+        return jnp.sum(x * x) + jnp.sum(dl * dl) + xn + dn
+
+    g_pipe = jax.grad(lambda p: loss(pipe, p))(p_pipe)
+    g_plain = jax.grad(lambda p: loss(plain, p))(p_plain)
+    # grads through the delta/x_norm terms reach O(100); scan-vs-unrolled
+    # fp32 reassociation shows up at ~1e-3 relative on single elements
+    for i in range(4):
+        want = jax.tree_util.tree_leaves(g_plain[f"layers_{i}"])
+        got = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda s, i=i: s[i],
+                                   g_pipe["pipeline_stack"])
+        )
+        for w, g in zip(want, got):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=1e-3, rtol=5e-3)
+
+
+def test_checkpoint_layout_conversion_roundtrip(mesh):
+    """A checkpoint saved with the plain per-layer layout must load into a
+    pipelined model (params restacked onto the pipe axis) and vice versa —
+    turning --pipeline-parallel-size on/off mid-project keeps the weights."""
+    from unicore_tpu import checkpoint_utils
+
+    emb = np.random.RandomState(0).randn(B, L, D).astype(np.float32)
+    enc_pipe = _encoder(pipeline=True)
+    enc_plain = _encoder(pipeline=False)
+    p_pipe = enc_pipe.init(
+        jax.random.key(0), jnp.asarray(emb), None, None, False
+    )["params"]
+    p_plain = enc_plain.init(
+        jax.random.key(1), jnp.asarray(emb), None, None, False
+    )["params"]
+
+    # plain checkpoint -> pipelined model: stack slices must equal layers
+    merged = checkpoint_utils.merge_params(
+        checkpoint_utils.to_numpy_tree(p_pipe),
+        checkpoint_utils.to_numpy_tree(p_plain),
+        strict=True,
+    )
+    for i in range(LAYERS):
+        want = jax.tree_util.tree_leaves_with_path(p_plain[f"layers_{i}"])
+        got_tree = jax.tree_util.tree_map(
+            lambda s, i=i: s[i], merged["pipeline_stack"]
+        )
+        got = jax.tree_util.tree_leaves_with_path(got_tree)
+        assert len(want) == len(got)
+        for (pw, w), (pg, g) in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    # pipelined checkpoint -> plain model: layers must equal stack slices
+    merged2 = checkpoint_utils.merge_params(
+        checkpoint_utils.to_numpy_tree(p_plain),
+        checkpoint_utils.to_numpy_tree(p_pipe),
+        strict=True,
+    )
+    for i in range(LAYERS):
+        want = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda s, i=i: s[i],
+                                   p_pipe["pipeline_stack"])
+        )
+        got = jax.tree_util.tree_leaves(merged2[f"layers_{i}"])
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_layout_conversion_refuses_depth_mismatch(mesh):
+    """A checkpoint whose layer count differs from the model must NOT be
+    silently truncated/padded by the layout converter — strict mode has to
+    report the mismatch (review finding, round 3)."""
+    from unicore_tpu import checkpoint_utils
+
+    emb = np.random.RandomState(0).randn(B, L, D).astype(np.float32)
+    enc_pipe = _encoder(pipeline=True)   # LAYERS layers, stacked
+    p_pipe = enc_pipe.init(
+        jax.random.key(0), jnp.asarray(emb), None, None, False
+    )["params"]
+
+    # plain checkpoint with MORE layers than the pipelined model
+    deep = TransformerEncoder(
+        encoder_layers=2 * LAYERS, embed_dim=D, ffn_embed_dim=2 * D,
+        attention_heads=4, dropout=0.0, emb_dropout=0.0,
+        attention_dropout=0.0, activation_dropout=0.0, max_seq_len=L,
+        rel_pos=True, post_ln=True,
+    )
+    p_deep = deep.init(
+        jax.random.key(1), jnp.asarray(emb), None, None, False
+    )["params"]
+    with pytest.raises(KeyError):
+        checkpoint_utils.merge_params(
+            checkpoint_utils.to_numpy_tree(p_pipe),
+            checkpoint_utils.to_numpy_tree(p_deep),
+            strict=True,
+        )
+
+    # stacked checkpoint into a DEEPER plain model: also a strict error,
+    # never an IndexError from indexing past the stack depth
+    p_deep_tpl = checkpoint_utils.to_numpy_tree(p_deep)
+    with pytest.raises(KeyError):
+        checkpoint_utils.merge_params(
+            p_deep_tpl, checkpoint_utils.to_numpy_tree(p_pipe), strict=True,
+        )

@@ -448,6 +448,7 @@ def merge_params(params, state_dict, strict=True):
 
     flat_params = _flatten_dict(params)
     flat_ckpt = _flatten_dict(state_dict)
+    flat_ckpt = _convert_pipeline_layout(flat_ckpt, flat_params)
     missing = [k for k in flat_params if k not in flat_ckpt]
     unexpected = [k for k in flat_ckpt if k not in flat_params]
     if strict and (missing or unexpected):
@@ -471,6 +472,140 @@ def merge_params(params, state_dict, strict=True):
         else:
             merged[k] = v
     return _unflatten_dict(merged)
+
+
+def _convert_pipeline_layout(flat_ckpt, flat_params):
+    """Translate between the plain per-layer param layout (`.../layers_i/...`
+    or `.../block_i/...`) and the pipelined stacked layout
+    (`.../pipeline_stack/...`, leading dim = num layers) so checkpoints
+    survive turning --pipeline-parallel-size on or off mid-project.
+
+    Works on the flattened key->array dicts; returns a (possibly) rewritten
+    copy of ``flat_ckpt`` matching ``flat_params``'s layout.  Keys that
+    don't participate pass through untouched."""
+    import re
+
+    # prefix may be empty when merge_params is handed a bare params subtree
+    layer_key = re.compile(r"^(?:(.*)/)?(?:layers|block)_(\d+)/(.+)$")
+    stack_key = re.compile(r"^(?:(.*)/)?pipeline_stack/(.+)$")
+
+    def _join(prefix, *parts):
+        return "/".join(([prefix] if prefix else []) + list(parts))
+
+    def _ckpt_layer_count(prefix):
+        """Number of per-layer entries the checkpoint holds under prefix
+        (max index + 1 across layers_i/block_i keys)."""
+        n = 0
+        for k in flat_ckpt:
+            m = layer_key.match(k)
+            if m is not None and (m.group(1) or "") == prefix:
+                n = max(n, int(m.group(2)) + 1)
+        return n
+
+    # Conversion only fires when the layer COUNTS match exactly — a depth
+    # mismatch (e.g. 8-layer checkpoint into a 4-stage model) must surface
+    # as strict-mode missing/unexpected keys, not silent truncation.
+
+    def stacked_to_plain():
+        """ckpt has pipeline_stack, model wants per-layer keys."""
+        model_counts = {}
+        for pk in flat_params:
+            m = layer_key.match(pk)
+            if m is not None:
+                prefix = m.group(1) or ""
+                model_counts[prefix] = max(
+                    model_counts.get(prefix, 0), int(m.group(2)) + 1
+                )
+        ok_prefixes = set()
+        for prefix, n_model in model_counts.items():
+            probe = next(
+                (
+                    k for k in flat_ckpt
+                    if (m := stack_key.match(k)) and (m.group(1) or "") == prefix
+                ),
+                None,
+            )
+            if probe is not None and (
+                int(np.asarray(flat_ckpt[probe]).shape[0]) == n_model
+            ):
+                ok_prefixes.add(prefix)
+        if not ok_prefixes:
+            return None
+        out = {}
+        converted = False
+        for k, v in flat_ckpt.items():
+            m = stack_key.match(k)
+            if m is None or (m.group(1) or "") not in ok_prefixes:
+                out[k] = v
+        for pk in flat_params:
+            m = layer_key.match(pk)
+            if m is None or pk in flat_ckpt:
+                continue
+            prefix, idx, suffix = m.group(1) or "", int(m.group(2)), m.group(3)
+            if prefix not in ok_prefixes:
+                continue
+            sk = _join(prefix, "pipeline_stack", suffix)
+            if sk in flat_ckpt:
+                out[pk] = np.asarray(flat_ckpt[sk])[idx]
+                converted = True
+        return out if converted else None
+
+    def plain_to_stacked():
+        """ckpt has per-layer keys, model wants pipeline_stack."""
+        out = dict(flat_ckpt)
+        converted = False
+        absorbed = set()
+        for pk, leaf in flat_params.items():
+            m = stack_key.match(pk)
+            if m is None or pk in flat_ckpt:
+                continue
+            prefix, suffix = m.group(1) or "", m.group(2)
+            n = int(leaf.shape[0])
+            if _ckpt_layer_count(prefix) != n:
+                continue  # depth mismatch: leave keys for strict to report
+            per = []
+            used = []
+            for i in range(n):
+                found = None
+                for word in ("layers", "block"):
+                    ck = _join(prefix, f"{word}_{i}", suffix)
+                    if ck in flat_ckpt:
+                        found = ck
+                        break
+                if found is None:
+                    per = None
+                    break
+                per.append(np.asarray(flat_ckpt[found]))
+                used.append(found)
+            if per is not None:
+                out[pk] = np.stack(per)
+                converted = True
+                absorbed.update(used)
+        if not converted:
+            return None
+        # drop exactly the per-layer keys that were absorbed into stacks;
+        # anything left over stays and trips strict mode
+        return {k: v for k, v in out.items() if k not in absorbed}
+
+    any_stack_in_params = any(stack_key.match(k) for k in flat_params)
+    any_stack_in_ckpt = any(stack_key.match(k) for k in flat_ckpt)
+    if any_stack_in_params and not any_stack_in_ckpt:
+        rewritten = plain_to_stacked()
+        if rewritten is not None:
+            logger.info(
+                "checkpoint layout: restacked per-layer params onto the "
+                "pipeline axis (plain -> pipelined)"
+            )
+            return rewritten
+    elif any_stack_in_ckpt and not any_stack_in_params:
+        rewritten = stacked_to_plain()
+        if rewritten is not None:
+            logger.info(
+                "checkpoint layout: unstacked pipeline params into "
+                "per-layer keys (pipelined -> plain)"
+            )
+            return rewritten
+    return flat_ckpt
 
 
 def _flatten_dict(tree, prefix=""):

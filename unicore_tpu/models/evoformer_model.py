@@ -33,6 +33,10 @@ class EvoformerModel(BaseUnicoreModel):
     max_seq_len: int = 256
     rel_pos_bins: int = 32
     remat: bool = False
+    # GPipe over the mesh 'pipe' axis (the 48-block stack is the natural
+    # pipeline candidate); set from --pipeline-parallel-size
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     @classmethod
     def add_args(cls, parser):
@@ -44,6 +48,9 @@ class EvoformerModel(BaseUnicoreModel):
         parser.add_argument("--dropout", type=float)
         parser.add_argument("--max-seq-len", type=int)
         parser.add_argument("--activation-checkpoint", action="store_true")
+        parser.add_argument("--pipeline-microbatches", type=int,
+                            help="GPipe microbatches per update when "
+                                 "--pipeline-parallel-size > 1")
 
     @classmethod
     def build_model(cls, args, task):
@@ -59,6 +66,13 @@ class EvoformerModel(BaseUnicoreModel):
             dropout=args.dropout,
             max_seq_len=args.max_seq_len,
             remat=getattr(args, "activation_checkpoint", False),
+            pipeline_stages=(
+                pp if (pp := getattr(args, "pipeline_parallel_size", 1)) > 1
+                else 0
+            ),
+            pipeline_microbatches=getattr(
+                args, "pipeline_microbatches", 4
+            ) or 4,
         )
 
     def setup(self):
@@ -93,6 +107,8 @@ class EvoformerModel(BaseUnicoreModel):
             pair_heads=self.pair_heads,
             dropout=self.dropout,
             remat=self.remat,
+            pipeline_stages=self.pipeline_stages,
+            pipeline_microbatches=self.pipeline_microbatches,
             name="evoformer",
         )
         self.masked_msa_head = nn.Dense(

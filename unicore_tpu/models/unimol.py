@@ -136,6 +136,9 @@ class UniMolModel(BaseUnicoreModel):
     masked_token_loss: float = 1.0
     masked_coord_loss: float = 1.0
     masked_dist_loss: float = 1.0
+    # GPipe over the mesh 'pipe' axis; set from --pipeline-parallel-size
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     supports_masked_gather = False  # heads need full-sequence features
 
@@ -157,6 +160,9 @@ class UniMolModel(BaseUnicoreModel):
         parser.add_argument("--masked-token-loss", type=float)
         parser.add_argument("--masked-coord-loss", type=float)
         parser.add_argument("--masked-dist-loss", type=float)
+        parser.add_argument("--pipeline-microbatches", type=int,
+                            help="GPipe microbatches per update when "
+                                 "--pipeline-parallel-size > 1")
 
     @classmethod
     def build_model(cls, args, task):
@@ -179,6 +185,13 @@ class UniMolModel(BaseUnicoreModel):
             masked_token_loss=args.masked_token_loss,
             masked_coord_loss=args.masked_coord_loss,
             masked_dist_loss=args.masked_dist_loss,
+            pipeline_stages=(
+                pp if (pp := getattr(args, "pipeline_parallel_size", 1)) > 1
+                else 0
+            ),
+            pipeline_microbatches=getattr(
+                args, "pipeline_microbatches", 4
+            ) or 4,
         )
 
     def setup(self):
@@ -206,6 +219,8 @@ class UniMolModel(BaseUnicoreModel):
             max_seq_len=self.max_seq_len,
             activation_fn=self.activation_fn,
             post_ln=self.post_ln,
+            pipeline_stages=self.pipeline_stages,
+            pipeline_microbatches=self.pipeline_microbatches,
             name="encoder",
         )
         if self.masked_token_loss > 0:

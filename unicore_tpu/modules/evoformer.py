@@ -157,8 +157,14 @@ class OuterProductMean(nn.Module):
             w = msa_mask.astype(m.dtype)[..., None]
             a = a * w
             b = b * w
-            norm = jnp.einsum("bri,brj->bij", msa_mask.astype(jnp.float32),
-                              msa_mask.astype(jnp.float32))[..., None] + 1e-3
+            # max (not +eps) keeps an all-ones mask EXACTLY equal to the
+            # unmasked R normalization — the pipelined stack relies on
+            # ones-mask == identity — while still guarding empty pairs
+            norm = jnp.maximum(
+                jnp.einsum("bri,brj->bij", msa_mask.astype(jnp.float32),
+                           msa_mask.astype(jnp.float32)),
+                1e-3,
+            )[..., None]
         else:
             norm = msa.shape[1]
         outer = jnp.einsum("brid,brje->bijde", a, b)
@@ -321,9 +327,20 @@ class EvoformerStack(nn.Module):
     pair_heads: int = 4
     dropout: float = 0.1
     remat: bool = True
+    # GPipe pipeline parallelism over the mesh 'pipe' axis
+    # (parallel/pipeline.py).  The 48-block stack is the model where PP
+    # earns its keep: each pipe rank holds num_blocks/P blocks' params and
+    # activations.  Requires num_blocks % stages == 0 and batch %
+    # pipeline_microbatches == 0.  0 = off.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None, pair_mask=None, train=False):
+        if self.pipeline_stages > 1:
+            return self._pipeline_forward(
+                msa, pair, msa_mask, pair_mask, train
+            )
         block_cls = EvoformerIteration
         if self.remat:
             # trade FLOPs for activation memory across the deep stack
@@ -340,3 +357,86 @@ class EvoformerStack(nn.Module):
                 name=f"block_{i}",
             )(msa, pair, msa_mask, pair_mask, train)
         return msa, pair
+
+    def _pipeline_forward(self, msa, pair, msa_mask, pair_mask, train):
+        """GPipe schedule: blocks stacked on a leading axis sharded over
+        'pipe'; the (msa, pair) pair streams ride each microbatch tree
+        together (same shape every stage, so the ring buffer is uniform)."""
+        from unicore_tpu.parallel.pipeline import gpipe, plan_schedule
+
+        assert self.num_blocks % self.pipeline_stages == 0, (
+            f"num_blocks {self.num_blocks} % stages {self.pipeline_stages}"
+        )
+        B, R, L, Dm = msa.shape
+        mesh, n_micro, mb, batched = plan_schedule(
+            self.pipeline_stages, B, self.pipeline_microbatches
+        )
+
+        template = EvoformerIteration(
+            msa_dim=self.msa_dim,
+            pair_dim=self.pair_dim,
+            msa_heads=self.msa_heads,
+            pair_heads=self.pair_heads,
+            dropout=self.dropout,
+        )
+
+        def stack_init(rng):
+            dmsa = jnp.zeros((1, 2, 8, self.msa_dim), jnp.float32)
+            dpair = jnp.zeros((1, 8, 8, self.pair_dim), jnp.float32)
+            keys = jax.random.split(rng, self.num_blocks)
+            per = [
+                template.init({"params": k}, dmsa, dpair, None, None,
+                              False)["params"]
+                for k in keys
+            ]
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per)
+
+        stack = self.param("pipeline_stack", stack_init)
+
+        # all-ones masks are the identity (mask_to_bias(1) == 0) and keep
+        # the pipeline's zero-filled bubble ticks NaN-free
+        if msa_mask is None:
+            msa_mask = jnp.ones((B, R, L), msa.dtype)
+        if pair_mask is None:
+            pair_mask = jnp.ones((B, L, L), pair.dtype)
+        mbs = {
+            "msa": msa.reshape(n_micro, mb, R, L, Dm),
+            "pair": pair.reshape(n_micro, mb, L, L, pair.shape[-1]),
+            "mm": msa_mask.reshape(n_micro, mb, R, L),
+            "pm": pair_mask.reshape(n_micro, mb, L, L),
+        }
+        rng = self.make_rng("dropout") if (train and self.dropout > 0) else None
+
+        def stage_apply(p_stack, tree, step_rng):
+            mb_tree, _consts = tree
+            m, z = mb_tree["msa"], mb_tree["pair"]
+            mm, pm = mb_tree["mm"], mb_tree["pm"]
+
+            def body(carry, xs):
+                p_block, li = xs
+                m_, z_ = carry
+                rngs = None
+                if step_rng is not None:
+                    rngs = {"dropout": jax.random.fold_in(step_rng, li)}
+                apply = template.apply
+                if self.remat:
+                    apply = jax.checkpoint(
+                        template.apply, static_argnums=(5,)
+                    )
+                m_, z_ = apply(
+                    {"params": p_block}, m_, z_, mm, pm, train, rngs=rngs
+                )
+                return (m_, z_), None
+
+            n_local = jax.tree_util.tree_leaves(p_stack)[0].shape[0]
+            (m, z), _ = jax.lax.scan(
+                body, (m, z), (p_stack, jnp.arange(n_local, dtype=jnp.int32))
+            )
+            return {"msa": m, "pair": z, "mm": mm, "pm": pm}
+
+        outs = gpipe(mesh, stage_apply, stack, mbs, {}, rng=rng,
+                     mb_spec=batched)
+        return (
+            outs["msa"].reshape(B, R, L, Dm),
+            outs["pair"].reshape(B, L, L, pair.shape[-1]),
+        )

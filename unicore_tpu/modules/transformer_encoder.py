@@ -341,24 +341,12 @@ class TransformerEncoder(nn.Module):
 
     def _pipeline_forward(self, x, attn_bias, padding_mask, train):
         """GPipe schedule over the mesh 'pipe' axis (parallel/pipeline.py)."""
-        from jax.sharding import PartitionSpec as P
+        from unicore_tpu.parallel.pipeline import gpipe, plan_schedule
 
-        from unicore_tpu.parallel import DATA_AXIS, get_global_mesh
-        from unicore_tpu.parallel.mesh import PIPE_AXIS
-        from unicore_tpu.parallel.pipeline import gpipe
-
-        mesh = get_global_mesh()
-        assert mesh is not None and mesh.shape[PIPE_AXIS] == self.pipeline_stages, (
-            f"pipeline_stages={self.pipeline_stages} needs a global mesh "
-            f"with a matching 'pipe' axis (got "
-            f"{None if mesh is None else dict(mesh.shape)})"
-        )
         B, L, D = x.shape
-        n_micro = self.pipeline_microbatches
-        assert B % n_micro == 0, (
-            f"batch {B} must divide pipeline_microbatches {n_micro}"
+        mesh, n_micro, mb, batched = plan_schedule(
+            self.pipeline_stages, B, self.pipeline_microbatches
         )
-        mb = B // n_micro
         template = self._pipe_template
 
         if padding_mask is None:
@@ -396,7 +384,6 @@ class TransformerEncoder(nn.Module):
             )
             return {"x": h, "pm": pm}
 
-        batched = P(None, DATA_AXIS) if DATA_AXIS in mesh.shape else P()
         outs = gpipe(
             mesh,
             stage_apply,

@@ -34,6 +34,12 @@ class TransformerEncoderWithPair(nn.Module):
     activation_fn: str = "gelu"
     post_ln: bool = False
     no_final_head_layer_norm: bool = False
+    # GPipe over the mesh 'pipe' axis (parallel/pipeline.py): both evolved
+    # streams (atom channel x AND the pair bias) ride each microbatch.
+    # Requires an attention bias input (Uni-Mol always provides one),
+    # encoder_layers % stages == 0, batch % pipeline_microbatches == 0.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 4
 
     def setup(self):
         self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
@@ -44,20 +50,43 @@ class TransformerEncoderWithPair(nn.Module):
             self.final_head_layer_norm = LayerNorm(
                 self.attention_heads, name="final_head_layer_norm"
             )
-        self.layers = [
-            TransformerEncoderLayer(
-                embed_dim=self.embed_dim,
-                ffn_embed_dim=self.ffn_embed_dim,
-                attention_heads=self.attention_heads,
-                dropout=self.dropout,
-                attention_dropout=self.attention_dropout,
-                activation_dropout=self.activation_dropout,
-                activation_fn=self.activation_fn,
-                post_ln=self.post_ln,
-                name=f"layers_{i}",
+        layer_kwargs = dict(
+            embed_dim=self.embed_dim,
+            ffn_embed_dim=self.ffn_embed_dim,
+            attention_heads=self.attention_heads,
+            dropout=self.dropout,
+            attention_dropout=self.attention_dropout,
+            activation_dropout=self.activation_dropout,
+            activation_fn=self.activation_fn,
+            post_ln=self.post_ln,
+        )
+        if self.pipeline_stages > 1:
+            assert self.encoder_layers % self.pipeline_stages == 0, (
+                f"encoder_layers {self.encoder_layers} % pipeline_stages "
+                f"{self.pipeline_stages}"
             )
-            for i in range(self.encoder_layers)
-        ]
+            template = TransformerEncoderLayer(**layer_kwargs)
+            self._pipe_template = template
+
+            def stack_init(rng):
+                dummy = jnp.zeros((1, 8, self.embed_dim), jnp.float32)
+                keys = jax.random.split(rng, self.encoder_layers)
+                per = [
+                    template.init({"params": k}, dummy, None, None, False,
+                                  False)["params"]
+                    for k in keys
+                ]
+                return jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *per
+                )
+
+            self.pipeline_stack = self.param("pipeline_stack", stack_init)
+            self.layers = []
+        else:
+            self.layers = [
+                TransformerEncoderLayer(name=f"layers_{i}", **layer_kwargs)
+                for i in range(self.encoder_layers)
+            ]
 
     def __call__(
         self,
@@ -77,21 +106,26 @@ class TransformerEncoderWithPair(nn.Module):
         input_attn_mask = attn_mask
         pair_bias = attn_mask  # (B, H, L, L) or None
         attn_weights = None
-        for layer in self.layers:
-            x, attn_weights, _ = layer(
-                x,
-                padding_mask=padding_mask,
-                attn_bias=pair_bias,
-                return_attn=True,
-                train=train,
+        if self.pipeline_stages > 1:
+            x, attn_weights = self._pipeline_forward(
+                x, pair_bias, padding_mask, train
             )
-            # pre-softmax weights become the evolved pair representation
-            pair_bias = attn_weights
+        else:
+            for layer in self.layers:
+                x, attn_weights, _ = layer(
+                    x,
+                    padding_mask=padding_mask,
+                    attn_bias=pair_bias,
+                    return_attn=True,
+                    train=train,
+                )
+                # pre-softmax weights become the evolved pair representation
+                pair_bias = attn_weights
 
         if not self.post_ln:
             x = self.final_layer_norm(x)
 
-        # regularization terms (Uni-Mol's x_norm / delta_pair_repr_norm):
+        # regularization terms (Uni-Mol x_norm / delta_pair_repr_norm):
         # penalize drift of token activations and pair weights
         def masked_norm(t, mask):
             if mask is None:
@@ -130,3 +164,61 @@ class TransformerEncoderWithPair(nn.Module):
             delta = d.transpose(0, 3, 1, 2)
 
         return x, pair_rep, delta, x_norm, delta_norm
+
+    def _pipeline_forward(self, x, pair_bias, padding_mask, train):
+        """GPipe schedule for the pair-evolving stack: each microbatch tree
+        carries BOTH streams (atom x and the running pair bias), so the
+        evolved pair representation rides the ring between stages."""
+        from unicore_tpu.parallel.pipeline import gpipe, plan_schedule
+
+        assert pair_bias is not None, (
+            "pipelined TransformerEncoderWithPair needs an attention-bias "
+            "input (the pair stream has no defined shape without it)"
+        )
+        B, L, D = x.shape
+        H = self.attention_heads
+        mesh, n_micro, mb, batched = plan_schedule(
+            self.pipeline_stages, B, self.pipeline_microbatches
+        )
+        if padding_mask is None:
+            padding_mask = jnp.zeros((B, L), jnp.int32)
+        bias = jnp.broadcast_to(pair_bias, (B, H, L, L))
+        mbs = {
+            "x": x.reshape(n_micro, mb, L, D),
+            "bias": bias.reshape(n_micro, mb, H, L, L),
+            "pm": padding_mask.reshape(n_micro, mb, L),
+        }
+        template = self._pipe_template
+        has_dropout = train and (
+            self.dropout > 0 or self.attention_dropout > 0
+            or self.activation_dropout > 0
+        )
+        rng = self.make_rng("dropout") if has_dropout else None
+
+        def stage_apply(p_stack, tree, step_rng):
+            mb_tree, _consts = tree
+            h, b, pm = mb_tree["x"], mb_tree["bias"], mb_tree["pm"]
+
+            def body(carry, xs):
+                p_layer, li = xs
+                h_, b_ = carry
+                rngs = None
+                if step_rng is not None:
+                    rngs = {"dropout": jax.random.fold_in(step_rng, li)}
+                h_, attn, _ = template.apply(
+                    {"params": p_layer}, h_, b_, pm, True, train, rngs=rngs
+                )
+                return (h_, attn), None
+
+            n_local = jax.tree_util.tree_leaves(p_stack)[0].shape[0]
+            (h, b), _ = jax.lax.scan(
+                body, (h, b), (p_stack, jnp.arange(n_local, dtype=jnp.int32))
+            )
+            return {"x": h, "bias": b, "pm": pm}
+
+        outs = gpipe(mesh, stage_apply, self.pipeline_stack, mbs, {},
+                     rng=rng, mb_spec=batched)
+        return (
+            outs["x"].reshape(B, L, D),
+            outs["bias"].reshape(B, H, L, L),
+        )

@@ -27,7 +27,38 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .mesh import PIPE_AXIS
+from .mesh import DATA_AXIS, PIPE_AXIS, get_global_mesh
+
+
+def plan_schedule(stages: int, batch: int, requested_micro: int,
+                  pipe_axis: str = PIPE_AXIS, data_axis: str = DATA_AXIS):
+    """Resolve the shared GPipe invocation decisions for a pipelined stack:
+    the global mesh (asserting its pipe axis matches ``stages``), the
+    microbatch count (degraded to the largest divisor of ``batch`` for tail
+    batches — worse bubble, still exact, one cached recompile per odd
+    shape), and the microbatch PartitionSpec (batch dim rides 'data' only
+    when it divides evenly; otherwise replicated).
+
+    One implementation for every pipelined stack (transformer_encoder,
+    transformer_encoder_with_pair, evoformer) so schedule fixes land once.
+
+    Returns (mesh, n_micro, mb, mb_spec)."""
+    mesh = get_global_mesh()
+    assert mesh is not None and mesh.shape[pipe_axis] == stages, (
+        f"pipeline_stages={stages} needs a global mesh with a matching "
+        f"'{pipe_axis}' axis (got "
+        f"{None if mesh is None else dict(mesh.shape)})"
+    )
+    n_micro = max(1, min(requested_micro, batch))
+    while batch % n_micro:
+        n_micro -= 1
+    mb = batch // n_micro
+    mb_spec = (
+        P(None, data_axis)
+        if data_axis in mesh.shape and mb % mesh.shape[data_axis] == 0
+        else P()
+    )
+    return mesh, n_micro, mb, mb_spec
 
 
 def gpipe(

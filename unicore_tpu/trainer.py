@@ -1474,6 +1474,29 @@ class Trainer(object):
     def _load_optim_state(self, last_optim_state, optimizer_overrides):
         if last_optim_state is None:
             return
+        # Structure mismatch means the param layout changed since the save
+        # (e.g. merge_params converted the model between the plain and
+        # pipelined layouts) — moments can't follow, so warn and train on
+        # with fresh optimizer state.  Anything ELSE (corrupt leaf, device
+        # OOM, ...) must still raise: silently dropping valid moments would
+        # quietly degrade convergence.
+        same_structure = jax.tree_util.tree_structure(
+            last_optim_state
+        ) == jax.tree_util.tree_structure(
+            checkpoint_utils.to_numpy_tree(self._state["opt"])
+        )
+        if not same_structure:
+            logger.warning(
+                "optimizer state in checkpoint does not match the current "
+                "param layout (tree structures differ — pipeline layout "
+                "change?); resetting optimizer state (Adam moments restart "
+                "from zero)"
+            )
+            self._state["opt"] = jax.device_put(
+                self._optimizer.init_state(self._state["params"]),
+                self._state_shardings(self._state)["opt"],
+            )
+            return
         restored = self._optimizer.load_state_dict(
             self._state["opt"], last_optim_state, optimizer_overrides
         )
