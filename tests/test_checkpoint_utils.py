@@ -93,3 +93,48 @@ def test_retention_prunes_interval_updates(tmp_path):
     assert "checkpoint_1_300.pt" in remaining
     assert "checkpoint_1_200.pt" not in remaining
     assert "checkpoint_1_100.pt" not in remaining
+
+
+def test_torch_export_roundtrip(tmp_path):
+    """save_torch_checkpoint writes a .pt that torch.load reads back with
+    dtypes/values intact — and that our own loader round-trips (the
+    torch-interop pair: import existed, export is new)."""
+    torch = pytest.importorskip("torch")
+    from unicore_tpu.checkpoint_utils import (
+        load_torch_checkpoint, save_torch_checkpoint,
+    )
+
+    from ml_dtypes import bfloat16
+
+    state = {
+        "model": {
+            "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": (np.ones((3,), np.float32) * 1.5).astype(bfloat16),
+        },
+        "extra_state": {"epoch": 3, "best": 0.25},
+        "history": [1, 2, 3],
+    }
+    path = str(tmp_path / "export.pt")
+    save_torch_checkpoint(state, path)
+
+    raw = torch.load(path, map_location="cpu", weights_only=False)
+    assert isinstance(raw["model"]["w"], torch.Tensor)
+    assert raw["extra_state"]["epoch"] == 3
+    np.testing.assert_array_equal(
+        raw["model"]["w"].numpy(), state["model"]["w"]
+    )
+    # the bf16 branch must land as real torch.bfloat16 with exact values
+    assert raw["model"]["b"].dtype == torch.bfloat16
+    np.testing.assert_array_equal(
+        raw["model"]["b"].float().numpy(),
+        state["model"]["b"].astype(np.float32),
+    )
+
+    back = load_torch_checkpoint(path)
+    np.testing.assert_array_equal(back["model"]["w"], state["model"]["w"])
+    assert back["model"]["b"].dtype == state["model"]["b"].dtype
+    np.testing.assert_array_equal(
+        back["model"]["b"].astype(np.float32),
+        state["model"]["b"].astype(np.float32),
+    )
+    assert back["history"] == [1, 2, 3]

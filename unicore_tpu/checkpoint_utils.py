@@ -350,11 +350,46 @@ def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
 
 
 def load_torch_checkpoint(path):
-    """One-way torch .pt -> numpy-pytree converter (Uni-Core interop)."""
+    """torch .pt -> numpy-pytree converter (Uni-Core interop)."""
     import torch
 
     state = torch.load(path, map_location="cpu", weights_only=False)
     return torch_to_pytree(state)
+
+
+def save_torch_checkpoint(state, path):
+    """The reverse interop: write a checkpoint state (numpy pytree, e.g.
+    ``load_checkpoint_to_cpu``'s result or ``Trainer.state_dict()``) as a
+    torch ``.pt`` file readable by the reference stack's ``torch.load``.
+
+    Arrays become torch tensors (bfloat16 round-trips via a float32 view);
+    everything else (args Namespace, scalars, nested dicts/lists) pickles
+    through torch's serializer unchanged.  Param-NAME mapping between the
+    two frameworks' module trees is the caller's concern — this converts
+    containers and dtypes only.
+    """
+    import torch
+
+    def convert(obj):
+        if isinstance(obj, np.ndarray):
+            if obj.dtype.name == "bfloat16":
+                return torch.from_numpy(
+                    obj.astype("float32")
+                ).to(torch.bfloat16)
+            return torch.from_numpy(np.ascontiguousarray(obj))
+        if isinstance(obj, np.generic):
+            return obj.item()
+        if isinstance(obj, dict):
+            return {k: convert(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(convert(v) for v in obj)
+        return obj
+
+    # atomic write, same as every other checkpoint write here: a torn .pt
+    # still carries the b'PK' magic and would crash (or fool) every reader
+    scratch = path + ".tmp"
+    torch.save(convert(state), scratch)
+    os.rename(scratch, path)
 
 
 def torch_to_pytree(obj):
