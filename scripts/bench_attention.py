@@ -87,35 +87,44 @@ def main():
     print(f"# device={kind} backend={jax.default_backend()} reps={REPS}",
           file=sys.stderr)
 
-    # (name, B, H, L, D, bias?) — the bundled families' hot shapes
+    # (name, B, H, L, D, bias_mode) — the bundled families' hot shapes.
+    # bias_mode: None, 'shared' ((1,H,L,L) broadcast — rel-pos style), or
+    # 'per_batch' ((B,H,L,L) — the MATERIALIZED form of evoformer's grouped
+    # MSA-row bias; timing flash-with-per-batch-bias vs xla on it is the
+    # go/no-go data for a grouped-bias kernel extension, which would read
+    # each of the 8 distinct groups once instead of B copies).
     configs = [
-        ("bert_seq512", 16, 12, 512, 64, False),
-        ("bert_seq256", 32, 12, 256, 64, False),
-        ("unimol_pair_seq256", 16, 8, 256, 64, True),  # pair bias (1,H,L,L)
+        ("bert_seq512", 16, 12, 512, 64, None),
+        ("bert_seq256", 32, 12, 256, 64, None),
+        ("unimol_pair_seq256", 16, 8, 256, 64, "shared"),
+        ("evoformer_msarow_seq256", 256, 8, 256, 32, "per_batch"),
     ]
     flash_blocks = [(128, 128), (128, 256), (256, 256), (256, 512),
                     (512, 512)]
     if not on_tpu:  # interpret-mode smoke: one tiny shape, timings bogus
-        configs = [("smoke_seq128", 1, 2, 128, 32, True)]
+        configs = [("smoke_seq128", 1, 2, 128, 32, "shared")]
         flash_blocks = [(128, 128)]
 
     best = {}
-    for name, B, H, L, D, with_bias in configs:
+    for name, B, H, L, D, bias_mode in configs:
         key = jax.random.PRNGKey(0)
         q, k, v = (
             jax.random.normal(jax.random.fold_in(key, i), (B, H, L, D),
                               jnp.bfloat16)
             for i in range(3)
         )
-        bias = (
-            jax.random.normal(jax.random.fold_in(key, 7), (1, H, L, L),
-                              jnp.float32)
-            if with_bias else None
-        )
+        bias = None
+        if bias_mode is not None:
+            bias_b = 1 if bias_mode == "shared" else B
+            bias = jax.random.normal(
+                jax.random.fold_in(key, 7), (bias_b, H, L, L), jnp.float32
+            )
         sm = D ** -0.5
 
         candidates = []
-        if fullrow_supported(L, L, D, 1 if with_bias else None):
+        if fullrow_supported(
+            L, L, D, None if bias is None else bias.shape[0]
+        ):
             candidates.append((
                 "fullrow",
                 lambda q, k, v: fullrow_attention(
@@ -138,7 +147,7 @@ def main():
 
         for path, fn in candidates:
             row = {"config": name, "path": path, "shape": [B, H, L, D],
-                   "bias": with_bias, "device_kind": kind}
+                   "bias": bias_mode, "device_kind": kind}
             try:
                 fwd = jax.jit(fn)
                 row["fwd_ms"] = round(_time(fwd, q, k, v) * 1e3, 3)

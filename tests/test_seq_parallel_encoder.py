@@ -123,3 +123,91 @@ def test_ring_encoder_training_with_dropout():
     assert all(
         bool(jnp.isfinite(x).all()) for x in jax.tree_util.tree_leaves(g)
     )
+
+
+def test_ulysses_encoder_matches_dense():
+    """seq_impl='ulysses': the all-to-all path must match the dense encoder
+    exactly (heads % seq axis == 0 engages it; same params)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    set_global_mesh(make_mesh(data=2, seq=4))
+    B, L, E, H = 2, 64, 64, 4  # H=4 divides seq=4
+    mk = lambda impl: TransformerEncoder(
+        encoder_layers=2, embed_dim=E, ffn_embed_dim=128, attention_heads=H,
+        max_seq_len=L, use_ring=impl is not None, emb_dropout=0.0,
+        dropout=0.0, attention_dropout=0.0,
+        seq_impl=impl or "ring",
+    )
+    enc_u, enc_d = mk("ulysses"), mk(None)
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    pm = jnp.asarray(
+        (np.arange(L)[None, :] >= np.array([50, 64])[:, None]).astype(np.float32)
+    )
+    params = enc_u.init({"params": jax.random.PRNGKey(1)}, emb)
+    o_u = enc_u.apply(params, emb, padding_mask=pm)
+    o_d = enc_d.apply(params, emb, padding_mask=pm)
+    assert float(jnp.abs(o_u - o_d).max()) < 1e-4
+
+    g_u = jax.grad(
+        lambda p: jnp.sum(enc_u.apply(p, emb, padding_mask=pm) ** 2)
+    )(params)
+    g_d = jax.grad(
+        lambda p: jnp.sum(enc_d.apply(p, emb, padding_mask=pm) ** 2)
+    )(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_u), jax.tree_util.tree_leaves(g_d)
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-4
+
+
+def test_ulysses_per_batch_bias():
+    """The all-to-all path handles per-BATCH biases (the ring cannot):
+    direct equivalence against the dense reference."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.ops.flash_attention import mha_reference
+    from unicore_tpu.parallel.ulysses import ulysses_self_attention
+
+    mesh = make_mesh(data=2, seq=4)
+    B, H, L, D = 4, 8, 64, 16
+    r = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(r.randn(B, H, L, D), jnp.float32)
+               for _ in range(3))
+    bias = jnp.asarray(r.randn(B, H, L, L), jnp.float32)
+    out = ulysses_self_attention(mesh, q, k, v, bias=bias,
+                                 sm_scale=D ** -0.5)
+    ref = mha_reference(q, k, v, bias=bias, sm_scale=D ** -0.5)
+    assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+def test_seq_parallel_cli_wiring():
+    """--seq-parallel-size > 1 must actually reach the encoder: the model
+    builder sets use_ring and the chosen impl (round-3 wiring-gap fix)."""
+    from argparse import Namespace
+
+    from unicore_tpu.models.bert import BertModel
+
+    class _T:
+        class _D:
+            def pad(self):
+                return 1
+
+            def __len__(self):
+                return 64
+
+        dictionary = _D()
+
+    args = Namespace(
+        seq_parallel_size=4, seq_parallel_impl="ulysses",
+        encoder_layers=2, encoder_embed_dim=64, encoder_ffn_embed_dim=128,
+        encoder_attention_heads=4, max_seq_len=64, dropout=0.0,
+        emb_dropout=0.0, attention_dropout=0.0, activation_dropout=0.0,
+        pooler_dropout=0.0, post_ln=True,
+    )
+    model = BertModel.build_model(args, _T())
+    assert model.use_ring is True
+    assert model.seq_impl == "ulysses"
+    args.seq_parallel_size = 1
+    model = BertModel.build_model(args, _T())
+    assert model.use_ring is False

@@ -106,6 +106,12 @@ class BertModel(BaseUnicoreModel):
     # (parallel/pipeline.py); 0 = off.  Set from --pipeline-parallel-size.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
+    # sequence parallelism over the mesh 'seq' axis; enabled automatically
+    # when --seq-parallel-size > 1.  impl: 'ring' (ppermute chunk rotation,
+    # scales with L) or 'ulysses' (all-to-all head sharding, full-row Pallas
+    # kernels, supports per-batch biases) — --seq-parallel-impl.
+    use_ring: bool = False
+    seq_impl: str = "ring"
 
     @classmethod
     def add_args(cls, parser):
@@ -181,6 +187,8 @@ class BertModel(BaseUnicoreModel):
                 else 0
             ),
             pipeline_microbatches=getattr(args, "pipeline_microbatches", 4) or 4,
+            use_ring=getattr(args, "seq_parallel_size", 1) > 1,
+            seq_impl=getattr(args, "seq_parallel_impl", "ring") or "ring",
         )
 
     def setup(self):
@@ -219,6 +227,8 @@ class BertModel(BaseUnicoreModel):
             moe_top_k=self.moe_top_k,
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
+            use_ring=self.use_ring,
+            seq_impl=self.seq_impl,
             name="sentence_encoder",
         )
         self.lm_head = BertLMHead(

@@ -6,10 +6,11 @@ pattern is exactly what its fused softmax kernel's bias-broadcast mode exists
 for (reference tests/test_softmax.py:81-170).  This module family provides
 the same computational blocks TPU-natively:
 
-- gated multi-head attention over arbitrary leading batch dims, routed
-  through the Pallas flash kernel when shapes allow (bias broadcast over the
-  leading dims maps to the kernel's (1|B, H, L, L) layout) and through the
-  XLA-fused softmax otherwise;
+- gated multi-head attention over arbitrary leading batch dims via the
+  XLA-fused softmax path (the L here is <=256 and the pair bias varies per
+  leading dim, outside the Pallas kernels' (1|B, 1|H, L, L) bias layout —
+  extending the kernels' bias broadcast to grouped leading dims is the
+  known follow-up, gated on on-TPU measurement);
 - MSA row attention with pair bias, MSA column attention;
 - outer-product-mean MSA -> pair update;
 - triangle multiplication (outgoing/incoming) and triangle attention

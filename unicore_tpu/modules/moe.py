@@ -172,6 +172,7 @@ class MoEEncoderLayer(nn.Module):
     activation_fn: str = "gelu"
     post_ln: bool = False
     use_ring: bool = False
+    seq_impl: str = "ring"
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
@@ -199,6 +200,7 @@ class MoEEncoderLayer(nn.Module):
             self.attention_heads,
             dropout=self.attention_dropout,
             use_ring=self.use_ring,
+            seq_impl=self.seq_impl,
             name="self_attn",
         )(
             x,

@@ -144,6 +144,30 @@ def _ring_ok(use_ring, return_attn, tgt_len, src_len, attn_bias,
     return mesh, bias_chunk
 
 
+def _ulysses_ok(use_seq, return_attn, tgt_len, src_len, attn_bias,
+                bsz, num_heads):
+    """Gate for the all-to-all (Ulysses) seq-parallel path: a live seq axis
+    dividing heads and length, self-attention shapes, and a bias expressible
+    in min-broadcast layout (per-BATCH biases are fine here, unlike the
+    ring).  Returns (mesh, bias4) or None."""
+    if not use_seq or return_attn:
+        return None
+    from unicore_tpu.parallel import get_global_mesh
+    from unicore_tpu.parallel.ulysses import ulysses_supported
+
+    mesh = get_global_mesh()
+    if not ulysses_supported(mesh, bsz, num_heads, tgt_len, src_len):
+        return None
+    bias4 = None
+    if attn_bias is not None:
+        bias4 = _bias_min_broadcast(
+            attn_bias, bsz, num_heads, tgt_len, src_len
+        )
+        if bias4 is None:
+            return None
+    return mesh, bias4
+
+
 def _attend(
     module,
     q, k, v,
@@ -154,8 +178,10 @@ def _attend(
     return_attn,
     use_flash,
     use_ring=False,
+    seq_impl="ring",
 ):
-    """Shared core: pick ring (seq-parallel) vs flash vs fused-softmax."""
+    """Shared core: pick seq-parallel (ring or all-to-all) vs flash vs
+    fused-softmax."""
     bsz, num_heads, tgt_len, head_dim = q.shape
     src_len = k.shape[2]
 
@@ -164,9 +190,57 @@ def _attend(
 
     eff_dropout = dropout_rate if train else 0.0
 
+    if use_ring and seq_impl == "ulysses":
+        uly = _ulysses_ok(
+            use_ring, return_attn, tgt_len, src_len, attn_bias, bsz,
+            num_heads,
+        )
+        if uly is None:
+            _warn_flash_fallback(
+                "requested --seq-parallel-impl ulysses cannot run for this "
+                f"attention (heads {num_heads} / seq len {tgt_len} must "
+                "divide the seq axis; return_attn unsupported) — trying the "
+                "ring, then plain attention"
+            )
+        else:
+            from unicore_tpu.parallel.ulysses import ulysses_self_attention
+
+            uly_mesh, bias4 = uly
+            seed = 0
+            if eff_dropout > 0.0:
+                seed = jax.random.randint(
+                    module.make_rng("dropout"), (), 0, 2 ** 31 - 1,
+                    dtype=jnp.int32,
+                )
+            o = ulysses_self_attention(
+                uly_mesh, q, k, v,
+                kv_padding_mask=key_padding_mask,
+                bias=bias4,
+                sm_scale=1.0,  # q is pre-scaled
+                dropout_rate=eff_dropout,
+                dropout_seed=seed,
+            )
+            return o, None, None
+
     ring = _ring_ok(
         use_ring, return_attn, tgt_len, src_len, attn_bias, bsz, num_heads,
     )
+    if use_ring and ring is None:
+        from unicore_tpu.parallel import SEQ_AXIS, get_global_mesh
+
+        _mesh = get_global_mesh()
+        if _mesh is not None and _mesh.shape.get(SEQ_AXIS, 1) > 1:
+            # a seq axis was carved out of the mesh but no seq-parallel
+            # path can serve this attention: the devices on that axis will
+            # do replicated work — say so (once)
+            _warn_flash_fallback(
+                "sequence parallelism requested (mesh seq axis "
+                f"{_mesh.shape[SEQ_AXIS]}) but no seq-parallel path "
+                f"supports this attention (L={tgt_len}, heads={num_heads}, "
+                f"return_attn={return_attn}, bias="
+                f"{None if attn_bias is None else tuple(attn_bias.shape)}) "
+                "— running replicated over the seq axis"
+            )
     if ring is not None:
         from unicore_tpu.parallel.ring_attention import ring_self_attention
 
@@ -275,7 +349,8 @@ class SelfMultiheadAttention(nn.Module):
     bias: bool = True
     scaling_factor: float = 1.0
     use_flash: bool = True
-    use_ring: bool = False  # seq-parallel ring attention over the mesh 'seq' axis
+    use_ring: bool = False  # seq parallelism over the mesh 'seq' axis
+    seq_impl: str = "ring"  # 'ring' (ppermute) or 'ulysses' (all-to-all)
 
     @nn.compact
     def __call__(
@@ -309,6 +384,7 @@ class SelfMultiheadAttention(nn.Module):
             self, q, k, v, key_padding_mask, attn_bias,
             self.dropout, train, return_attn, self.use_flash,
             use_ring=self.use_ring,
+            seq_impl=self.seq_impl,
         )
 
         o = _merge_heads(o)

@@ -88,6 +88,7 @@ class TransformerEncoderLayer(nn.Module):
     activation_fn: str = "gelu"
     post_ln: bool = False
     use_ring: bool = False
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(
@@ -115,6 +116,7 @@ class TransformerEncoderLayer(nn.Module):
             self.attention_heads,
             dropout=self.attention_dropout,
             use_ring=self.use_ring,
+            seq_impl=self.seq_impl,
             name="self_attn",
         )(
             x,
@@ -180,7 +182,8 @@ class TransformerEncoder(nn.Module):
     post_ln: bool = False
     remat: bool = False  # activation checkpointing per layer
                          # (reference utils.checkpoint_sequential, utils.py:306-333)
-    use_ring: bool = False  # seq-parallel ring attention (mesh 'seq' axis)
+    use_ring: bool = False  # seq parallelism (mesh 'seq' axis)
+    seq_impl: str = "ring"  # 'ring' or 'ulysses' (--seq-parallel-impl)
     # mixture-of-experts FFN (expert parallelism, modules/moe.py): every
     # moe_every-th layer swaps its dense FFN for num_experts routed experts
     moe_experts: int = 0
@@ -224,6 +227,7 @@ class TransformerEncoder(nn.Module):
                 activation_fn=self.activation_fn,
                 post_ln=self.post_ln,
                 use_ring=self.use_ring,
+                seq_impl=self.seq_impl,
                 name=f"layers_{i}",
             )
             # every moe_every-th layer (starting at moe_every - 1, so layer 0
@@ -241,6 +245,11 @@ class TransformerEncoder(nn.Module):
             # stacked per-layer params for the GPipe schedule: leading dim
             # num_layers, sharded over 'pipe' by DEFAULT_PP_RULES
             assert self.moe_experts == 0, "MoE inside the pipeline: unsupported"
+            assert not self.use_ring, (
+                "sequence parallelism inside the pipeline is unsupported "
+                "(the stage template would need a nested seq shard_map); "
+                "drop --seq-parallel-size or --pipeline-parallel-size"
+            )
             template = TransformerEncoderLayer(
                 embed_dim=self.embed_dim,
                 ffn_embed_dim=self.ffn_embed_dim,

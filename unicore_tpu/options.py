@@ -292,6 +292,12 @@ def add_distributed_training_args(parser, default_world_size=None):
                        help="size of the 'model' (tensor-parallel) mesh axis")
     group.add_argument("--seq-parallel-size", type=int, default=1, metavar="N",
                        help="size of the 'seq' (sequence/context-parallel) mesh axis")
+    group.add_argument("--seq-parallel-impl", type=str, default="ring",
+                       choices=["ring", "ulysses"],
+                       help="sequence-parallel attention strategy: 'ring' "
+                            "(ppermute k/v rotation; scales with L) or "
+                            "'ulysses' (all-to-all head sharding; full-row "
+                            "kernels, needs heads %% seq axis == 0)")
     group.add_argument("--pipeline-parallel-size", type=int, default=1, metavar="N",
                        help="size of the 'pipe' (pipeline-parallel) mesh axis")
     group.add_argument("--expert-parallel-size", type=int, default=1, metavar="N",
