@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Convert checkpoints between this framework's pickle format and torch .pt.
+
+The operator face of the two-way interop in checkpoint_utils (import:
+``load_torch_checkpoint``; export: ``save_torch_checkpoint``):
+
+    # bring Uni-Core / Uni-Mol weights over (torch -> pickle pytree)
+    python scripts/convert_checkpoint.py uni_mol.pt converted.pt --to pickle
+
+    # hand a unicore_tpu checkpoint back to the reference stack's torch.load
+    python scripts/convert_checkpoint.py checkpoint_last.pt export.pt --to torch
+
+The input format is auto-detected (torch >= 1.6 zipfiles start with the
+b'PK' magic; everything else is read as this framework's pickle).  Param
+NAMES are converted as-is — mapping module paths between the two
+frameworks' trees (e.g. ``encoder.layers.0.self_attn`` vs
+``sentence_encoder/layers_0/self_attn``) is model-specific and left to the
+caller; ``--list`` prints the flattened keys to make writing such a mapping
+easy.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="convert checkpoints between unicore_tpu pickle and torch .pt"
+    )
+    ap.add_argument("src", help="input checkpoint (format auto-detected)")
+    ap.add_argument("dst", nargs="?", help="output path (omit with --list)")
+    ap.add_argument("--to", choices=["torch", "pickle"], default=None,
+                    help="output format (default: the opposite of the input)")
+    ap.add_argument("--list", action="store_true",
+                    help="print the flattened model-param keys and exit")
+    args = ap.parse_args()
+
+    from unicore_tpu.checkpoint_utils import (
+        _flatten_dict,
+        load_checkpoint_to_cpu,
+        persistent_save,
+        save_torch_checkpoint,
+    )
+
+    with open(args.src, "rb") as f:
+        src_is_torch = f.read(2) == b"PK"
+    state = load_checkpoint_to_cpu(args.src)
+
+    if args.list:
+        model = state.get("model", state)
+        for k, v in sorted(_flatten_dict(model).items()):
+            shape = getattr(v, "shape", None)
+            dtype = getattr(v, "dtype", type(v).__name__)
+            print(f"{k}  {tuple(shape) if shape is not None else ''} {dtype}")
+        return
+
+    if args.dst is None:
+        ap.error("dst is required unless --list")
+    to = args.to or ("pickle" if src_is_torch else "torch")
+    if to == "torch":
+        save_torch_checkpoint(state, args.dst)
+    else:
+        # persistent_save logs-and-continues on failure (fire-and-forget
+        # training semantics); a conversion tool must fail loudly instead
+        persistent_save(state, args.dst)
+        if not os.path.exists(args.dst):
+            sys.exit(f"error: failed to write {args.dst} (see log above)")
+    print(f"wrote {args.dst} ({to}; source was "
+          f"{'torch' if src_is_torch else 'pickle'})")
+
+
+if __name__ == "__main__":
+    main()

@@ -376,7 +376,13 @@ def save_torch_checkpoint(state, path):
                 return torch.from_numpy(
                     obj.astype("float32")
                 ).to(torch.bfloat16)
-            return torch.from_numpy(np.ascontiguousarray(obj))
+            # zero-copy wrap when possible; copy only read-only buffers
+            # (orbax/mmap-backed arrays arrive read-only, which torch
+            # refuses to wrap)
+            arr = np.ascontiguousarray(obj)
+            if not arr.flags.writeable:
+                arr = arr.copy()
+            return torch.from_numpy(arr)
         if isinstance(obj, np.generic):
             return obj.item()
         if isinstance(obj, dict):
