@@ -211,3 +211,43 @@ def test_seq_parallel_cli_wiring():
     args.seq_parallel_size = 1
     model = BertModel.build_model(args, _T())
     assert model.use_ring is False
+
+
+def test_trainer_refuses_seq_axis_without_model_support():
+    """A seq mesh axis with a model that can't use it would silently do
+    replicated work — the Trainer must refuse loudly (round-3 review)."""
+    from argparse import Namespace
+
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    class _T(UnicoreTask):
+        class _D:
+            def pad(self):
+                return 1
+
+        dictionary = _D()
+
+    args = Namespace(
+        seed=1, bf16=False, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=0.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=4,
+        pipeline_parallel_size=1, expert_parallel_size=1,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8,
+        weight_decay=0.0, force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, ema_decay=-1.0, validate_with_ema=False,
+        max_update=10, update_freq=[1], donate_train_state=False,
+        no_weight_decay_names="",
+    )
+    # a model that did NOT opt into sequence parallelism
+    model = BertModel(
+        vocab_size=64, padding_idx=1, encoder_layers=1,
+        encoder_embed_dim=32, encoder_ffn_embed_dim=64,
+        encoder_attention_heads=4, max_seq_len=32, post_ln=True,
+    )
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        Trainer(args, _T(args), model, LOSS_REGISTRY["masked_lm"](_T(args)))
