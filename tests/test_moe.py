@@ -153,8 +153,8 @@ def test_scatter_dispatch_matches_dense():
         scat = build("scatter", cf)
         params = dense.init({"params": jax.random.PRNGKey(1)}, x)
 
-        out_d = dense.apply(params, x)
-        out_s = scat.apply(params, x)
+        out_d = jax.jit(lambda p: dense.apply(p, x))(params)
+        out_s = jax.jit(lambda p: scat.apply(p, x))(params)
         assert float(jnp.abs(out_d - out_s).max()) < 1e-5, cf
 
         def loss_fn(layer):
@@ -162,8 +162,8 @@ def test_scatter_dispatch_matches_dense():
                 return jnp.sum(layer.apply(p, inp) ** 2)
             return f
 
-        gd_p, gd_x = jax.grad(loss_fn(dense), argnums=(0, 1))(params, x)
-        gs_p, gs_x = jax.grad(loss_fn(scat), argnums=(0, 1))(params, x)
+        gd_p, gd_x = jax.jit(jax.grad(loss_fn(dense), argnums=(0, 1)))(params, x)
+        gs_p, gs_x = jax.jit(jax.grad(loss_fn(scat), argnums=(0, 1)))(params, x)
         assert float(jnp.abs(gd_x - gs_x).max()) < 1e-4, cf
         for a, b in zip(
             jax.tree_util.tree_leaves(gd_p), jax.tree_util.tree_leaves(gs_p)

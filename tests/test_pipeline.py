@@ -75,8 +75,12 @@ def setup(mesh):
 
 def test_forward_matches_plain_stack(setup):
     emb, enc_pipe, enc_plain, p_pipe, p_plain = setup
-    y_pipe = enc_pipe.apply({"params": p_pipe}, emb, None, None, False)
-    y_plain = enc_plain.apply({"params": p_plain}, emb, None, None, False)
+    apply_j = jax.jit(
+        lambda enc, p: enc.apply({"params": p}, emb, None, None, False),
+        static_argnums=0,
+    )
+    y_pipe = apply_j(enc_pipe, p_pipe)
+    y_plain = apply_j(enc_plain, p_plain)
     np.testing.assert_allclose(
         np.asarray(y_pipe), np.asarray(y_plain), atol=1e-5, rtol=1e-5
     )
@@ -93,8 +97,8 @@ def test_backward_matches_plain_stack(setup):
         y = enc_plain.apply({"params": p}, emb, None, None, False)
         return jnp.sum(y * y)
 
-    g_pipe = jax.grad(loss_pipe)(p_pipe)
-    g_plain = jax.grad(loss_plain)(p_plain)
+    g_pipe = jax.jit(jax.grad(loss_pipe))(p_pipe)
+    g_plain = jax.jit(jax.grad(loss_plain))(p_plain)
 
     # layer grads: the stacked leaf's slice i must equal layer i's grad
     for i in range(LAYERS):
@@ -231,8 +235,8 @@ def test_evoformer_pipeline_matches_plain(mesh):
             lambda s, i=i: s[i], p_pipe["pipeline_stack"]
         )
 
-    m1, z1 = pipe.apply({"params": p_pipe}, msa, pair)
-    m2, z2 = plain.apply({"params": p_plain}, msa, pair)
+    m1, z1 = jax.jit(lambda p: pipe.apply({"params": p}, msa, pair))(p_pipe)
+    m2, z2 = jax.jit(lambda p: plain.apply({"params": p}, msa, pair))(p_plain)
     np.testing.assert_allclose(np.asarray(m1), np.asarray(m2),
                                atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(np.asarray(z1), np.asarray(z2),
@@ -246,8 +250,8 @@ def test_evoformer_pipeline_matches_plain(mesh):
         m, z = plain.apply({"params": p}, msa, pair)
         return jnp.sum(m * m) + jnp.sum(z * z)
 
-    g_pipe = jax.grad(loss_pipe)(p_pipe)
-    g_plain = jax.grad(loss_plain)(p_plain)
+    g_pipe = jax.jit(jax.grad(loss_pipe))(p_pipe)
+    g_plain = jax.jit(jax.grad(loss_plain))(p_plain)
     for i in range(EBLOCKS):
         want = jax.tree_util.tree_leaves(g_plain[f"block_{i}"])
         got = jax.tree_util.tree_leaves(
@@ -297,8 +301,8 @@ def test_pair_encoder_pipeline_matches_plain(mesh):
         if shared in p_pipe:
             p_plain[shared] = p_pipe[shared]
 
-    o_pipe = pipe.apply({"params": p_pipe}, emb, bias)
-    o_plain = plain.apply({"params": p_plain}, emb, bias)
+    o_pipe = jax.jit(lambda p: pipe.apply({"params": p}, emb, bias))(p_pipe)
+    o_plain = jax.jit(lambda p: plain.apply({"params": p}, emb, bias))(p_plain)
     # (x, pair_rep, delta, x_norm, delta_norm) — all five must agree
     for a, b in zip(o_pipe, o_plain):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -308,8 +312,8 @@ def test_pair_encoder_pipeline_matches_plain(mesh):
         x, pr, dl, xn, dn = enc_.apply({"params": p}, emb, bias)
         return jnp.sum(x * x) + jnp.sum(dl * dl) + xn + dn
 
-    g_pipe = jax.grad(lambda p: loss(pipe, p))(p_pipe)
-    g_plain = jax.grad(lambda p: loss(plain, p))(p_plain)
+    g_pipe = jax.jit(jax.grad(lambda p: loss(pipe, p)))(p_pipe)
+    g_plain = jax.jit(jax.grad(lambda p: loss(plain, p)))(p_plain)
     # grads through the delta/x_norm terms reach O(100); scan-vs-unrolled
     # fp32 reassociation shows up at ~1e-3 relative on single elements
     for i in range(4):

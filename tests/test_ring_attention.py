@@ -59,8 +59,9 @@ def test_ring_gradients_match(with_bias):
         )
 
     argnums = (0, 1, 2, 3) if with_bias else (0, 1, 2)
-    g1 = jax.grad(loss_ring, argnums=argnums)(q, k, v, bias)
-    g2 = jax.grad(loss_ref, argnums=argnums)(q, k, v, bias)
+    # jit: the eager shard_map ppermute chain is very slow on 1 core
+    g1 = jax.jit(jax.grad(loss_ring, argnums=argnums))(q, k, v, bias)
+    g2 = jax.jit(jax.grad(loss_ref, argnums=argnums))(q, k, v, bias)
     for name, a, b in zip(["dq", "dk", "dv", "dbias"], g1, g2):
         err = float(jnp.abs(a - b).max())
         assert err < 1e-4, f"{name}: {err}"
@@ -99,24 +100,26 @@ def test_ring_dropout_deterministic_and_mass_preserving():
     k = jax.random.normal(jax.random.PRNGKey(1), (B, H, L, D))
     v = jnp.ones((B, H, L, D))
     rng = jax.random.PRNGKey(7)
-    o1 = ring_self_attention(mesh, q, k, v, dropout_rate=0.4,
-                             dropout_rng=rng, sm_scale=D ** -0.5)
-    o2 = ring_self_attention(mesh, q, k, v, dropout_rate=0.4,
-                             dropout_rng=rng, sm_scale=D ** -0.5)
-    o3 = ring_self_attention(mesh, q, k, v, dropout_rate=0.4,
-                             dropout_rng=jax.random.PRNGKey(8),
-                             sm_scale=D ** -0.5)
+    ring = jax.jit(
+        lambda q_, k_, v_, r: ring_self_attention(
+            mesh, q_, k_, v_, dropout_rate=0.4, dropout_rng=r,
+            sm_scale=D ** -0.5,
+        )
+    )
+    o1 = ring(q, k, v, rng)
+    o2 = ring(q, k, v, rng)
+    o3 = ring(q, k, v, jax.random.PRNGKey(8))
     assert bool(jnp.all(o1 == o2))
     assert bool(jnp.any(o1 != o3))
     # v == ones: expected output is ~1 (inverted dropout preserves mass)
     assert abs(float(jnp.mean(o1)) - 1.0) < 0.05
     # grads flow
-    g = jax.grad(
+    g = jax.jit(jax.grad(
         lambda q_: jnp.sum(
             ring_self_attention(mesh, q_, k, v, dropout_rate=0.4,
                                 dropout_rng=rng, sm_scale=D ** -0.5) ** 2
         )
-    )(q)
+    ))(q)
     assert bool(jnp.isfinite(g).all())
 
 
@@ -176,8 +179,8 @@ def test_pallas_ring_matches_reference(with_bias):
             )
 
         argnums = (0, 1, 2) if bias is None else (0, 1, 2, 3)
-        g_ring = jax.grad(loss_ring, argnums)(q, k, v, bias)
-        g_ref = jax.grad(loss_ref, argnums)(q, k, v, bias)
+        g_ring = jax.jit(jax.grad(loss_ring, argnums))(q, k, v, bias)
+        g_ref = jax.jit(jax.grad(loss_ref, argnums))(q, k, v, bias)
         for gr, gf in zip(g_ring, g_ref):
             err = float(jnp.abs(gr - gf).max())
             scale = float(jnp.abs(gf).max()) + 1e-6

@@ -41,18 +41,24 @@ def test_ring_encoder_matches_dense():
         (np.arange(L)[None, :] >= np.array([100, 128])[:, None]).astype(np.float32)
     )
     params = enc_ring.init({"params": jax.random.PRNGKey(1)}, emb)
-    o_ring = enc_ring.apply(params, emb, padding_mask=pm)
-    o_dense = enc_dense.apply(params, emb, padding_mask=pm)
+    # jit: eager shard_map ppermute chains are pathologically slow on the
+    # 1-core CI box; compiled, the whole test drops several-fold in wall
+    o_ring = jax.jit(
+        lambda p, e: enc_ring.apply(p, e, padding_mask=pm)
+    )(params, emb)
+    o_dense = jax.jit(
+        lambda p, e: enc_dense.apply(p, e, padding_mask=pm)
+    )(params, emb)
     err = float(jnp.abs(o_ring - o_dense).max())
     assert err < 1e-4, err
 
     # gradients flow through the ring path (incl. rel-pos bias params)
-    g_ring = jax.grad(
+    g_ring = jax.jit(jax.grad(
         lambda p: jnp.sum(enc_ring.apply(p, emb, padding_mask=pm) ** 2)
-    )(params)
-    g_dense = jax.grad(
+    ))(params)
+    g_dense = jax.jit(jax.grad(
         lambda p: jnp.sum(enc_dense.apply(p, emb, padding_mask=pm) ** 2)
-    )(params)
+    ))(params)
     for a, b in zip(
         jax.tree_util.tree_leaves(g_ring), jax.tree_util.tree_leaves(g_dense)
     ):
@@ -109,17 +115,20 @@ def test_ring_encoder_training_with_dropout():
     params = enc.init(
         {"params": jax.random.PRNGKey(1), "dropout": jax.random.PRNGKey(2)}, emb
     )
-    o1 = enc.apply(params, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)})
-    o2 = enc.apply(params, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)})
-    o3 = enc.apply(params, emb, train=True, rngs={"dropout": jax.random.PRNGKey(4)})
+    fwd = jax.jit(
+        lambda p, e, r: enc.apply(p, e, train=True, rngs={"dropout": r})
+    )
+    o1 = fwd(params, emb, jax.random.PRNGKey(3))
+    o2 = fwd(params, emb, jax.random.PRNGKey(3))
+    o3 = fwd(params, emb, jax.random.PRNGKey(4))
     assert bool(jnp.all(o1 == o2))       # deterministic per rng
     assert bool(jnp.any(o1 != o3))       # varies across rngs
     assert bool(jnp.isfinite(o1).all())
-    g = jax.grad(
+    g = jax.jit(jax.grad(
         lambda p: jnp.sum(
             enc.apply(p, emb, train=True, rngs={"dropout": jax.random.PRNGKey(3)}) ** 2
         )
-    )(params)
+    ))(params)
     assert all(
         bool(jnp.isfinite(x).all()) for x in jax.tree_util.tree_leaves(g)
     )
@@ -144,16 +153,16 @@ def test_ulysses_encoder_matches_dense():
         (np.arange(L)[None, :] >= np.array([50, 64])[:, None]).astype(np.float32)
     )
     params = enc_u.init({"params": jax.random.PRNGKey(1)}, emb)
-    o_u = enc_u.apply(params, emb, padding_mask=pm)
-    o_d = enc_d.apply(params, emb, padding_mask=pm)
+    o_u = jax.jit(lambda p, e: enc_u.apply(p, e, padding_mask=pm))(params, emb)
+    o_d = jax.jit(lambda p, e: enc_d.apply(p, e, padding_mask=pm))(params, emb)
     assert float(jnp.abs(o_u - o_d).max()) < 1e-4
 
-    g_u = jax.grad(
+    g_u = jax.jit(jax.grad(
         lambda p: jnp.sum(enc_u.apply(p, emb, padding_mask=pm) ** 2)
-    )(params)
-    g_d = jax.grad(
+    ))(params)
+    g_d = jax.jit(jax.grad(
         lambda p: jnp.sum(enc_d.apply(p, emb, padding_mask=pm) ** 2)
-    )(params)
+    ))(params)
     for a, b in zip(
         jax.tree_util.tree_leaves(g_u), jax.tree_util.tree_leaves(g_d)
     ):
