@@ -10,8 +10,9 @@ The operator face of the two-way interop in checkpoint_utils (import:
     # hand a unicore_tpu checkpoint back to the reference stack's torch.load
     python scripts/convert_checkpoint.py checkpoint_last.pt export.pt --to torch
 
-The input format is auto-detected (torch >= 1.6 zipfiles start with the
-b'PK' magic; everything else is read as this framework's pickle).  Param
+The input format is auto-detected (torch >= 1.6 zipfiles by the b'PK'
+magic, legacy non-zipfile torch .pt by its magic-number pickle header;
+everything else is read as this framework's pickle).  Param
 NAMES are converted as-is — mapping module paths between the two
 frameworks' trees (e.g. ``encoder.layers.0.self_attn`` vs
 ``sentence_encoder/layers_0/self_attn``) is model-specific and left to the
@@ -40,13 +41,15 @@ def main():
 
     from unicore_tpu.checkpoint_utils import (
         _flatten_dict,
+        detect_checkpoint_format,
         load_checkpoint_to_cpu,
         persistent_save,
         save_torch_checkpoint,
     )
 
-    with open(args.src, "rb") as f:
-        src_is_torch = f.read(2) == b"PK"
+    # handles legacy (pre-1.6, non-zipfile) torch .pt too — those have no
+    # b'PK' magic but are still torch, not this framework's pickle
+    src_is_torch = detect_checkpoint_format(args.src) == "torch"
     state = load_checkpoint_to_cpu(args.src)
 
     if args.list:

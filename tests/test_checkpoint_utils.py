@@ -37,6 +37,41 @@ def test_torch_checkpoint_interop(tmp_path):
     )
 
 
+def test_legacy_torch_checkpoint_autodetected(tmp_path):
+    """A LEGACY (pre-1.6, non-zipfile) torch .pt has no b'PK' magic, so the
+    naive sniff would route it to pickle.load and die confusingly; both the
+    loader fallback and detect_checkpoint_format must treat it as torch."""
+    torch = pytest.importorskip("torch")
+    state = {
+        "model": {"w": torch.randn(3, 2), "scalar": torch.tensor(1.5)},
+        "extra_state": {"epoch": 7},
+    }
+    path = str(tmp_path / "legacy.pt")
+    torch.save(state, path, _use_new_zipfile_serialization=False)
+    with open(path, "rb") as f:
+        assert f.read(2) != b"PK"  # genuinely the legacy stream
+
+    assert checkpoint_utils.detect_checkpoint_format(path) == "torch"
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    assert isinstance(loaded["model"]["w"], np.ndarray)
+    np.testing.assert_allclose(loaded["model"]["w"], state["model"]["w"].numpy())
+    assert loaded["extra_state"]["epoch"] == 7
+
+
+def test_plain_pickled_torch_tensors_convert(tmp_path):
+    """A state dict pickled with plain pickle but carrying torch tensors
+    (no torch.save involved) still converts to a numpy pytree on load."""
+    torch = pytest.importorskip("torch")
+    import pickle
+
+    path = str(tmp_path / "plain.pt")
+    with open(path, "wb") as f:
+        pickle.dump({"model": {"w": torch.ones(2, 2)}}, f)
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    assert isinstance(loaded["model"]["w"], np.ndarray)
+    assert checkpoint_utils.detect_checkpoint_format(path) == "pickle"
+
+
 def test_native_checkpoint_roundtrip(tmp_path):
     obj = {"model": {"w": np.arange(6).reshape(2, 3)}, "extra_state": {"k": 1}}
     path = str(tmp_path / "ckpt.pt")

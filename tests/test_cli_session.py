@@ -135,3 +135,83 @@ def test_hard_stop_max_update_and_lr_floor():
     assert s.lr_floor_reached()  # fake lr 1e-4 <= 1e-3
     s = _session(1)  # stop_min_lr -1: disabled
     assert not s.lr_floor_reached()
+
+
+# ---------------------------------------------------------------------------
+# validate(): device-accumulation gating on logging_outputs_can_be_summed
+# ---------------------------------------------------------------------------
+
+def _validate_with_loss(summable: bool):
+    """Drive cli.validate() with stub trainer/task; returns (accumulate
+    flags seen by valid_step, the list reduce_metrics received, whether
+    finish_valid_accum ran)."""
+    from unicore_tpu_cli.train import validate
+
+    seen = {"accum": [], "reduced": None, "drained": False}
+
+    class _Loss:
+        @staticmethod
+        def logging_outputs_can_be_summed(is_train):
+            return summable
+
+    class _EpochItr:
+        epoch = 1
+
+    class _Batches(list):
+        def next_epoch_itr(self, shuffle=False):
+            return self
+
+    class _FakeValidTrainer:
+        loss = _Loss()
+
+        def begin_valid_epoch(self, epoch):
+            pass
+
+        def get_valid_iterator(self, subset):
+            return _Batches([{"i": 0}, {"i": 1}, {"i": 2}])
+
+        def valid_step(self, sample, seed=None, accumulate=False):
+            seen["accum"].append(accumulate)
+            return None if accumulate else {"loss": 1.0, "sample_size": 1}
+
+        def finish_valid_accum(self):
+            seen["drained"] = True
+            return {"loss": 3.0, "sample_size": 3}
+
+        def get_num_updates(self):
+            return 5
+
+    class _FakeTask:
+        datasets = {"valid": object()}
+
+        @staticmethod
+        def logging_outputs_can_be_summed(loss, is_train):
+            return loss.logging_outputs_can_be_summed(is_train)
+
+        def reduce_metrics(self, outs, loss, split=None):
+            seen["reduced"] = list(outs)
+
+    args = Namespace(
+        fixed_validation_seed=None, max_valid_steps=None,
+        best_checkpoint_metric="loss", maximize_best_checkpoint_metric=False,
+        no_progress_bar=True, log_format=None, log_interval=100,
+        tensorboard_logdir=None,
+    )
+    validate(args, _FakeValidTrainer(), _FakeTask(), _EpochItr(), ["valid"])
+    return seen
+
+
+def test_validate_summable_loss_accumulates_on_device():
+    seen = _validate_with_loss(summable=True)
+    assert seen["accum"] == [True, True, True]
+    assert seen["drained"] is True
+    assert seen["reduced"] == [{"loss": 3.0, "sample_size": 3}]
+
+
+def test_validate_nonsummable_loss_collects_per_batch():
+    """ADVICE r3 (medium): a loss with logging_outputs_can_be_summed(False)
+    must NOT be device-summed — reduce_metrics gets every batch's output."""
+    seen = _validate_with_loss(summable=False)
+    assert seen["accum"] == [False, False, False]
+    assert seen["drained"] is False
+    assert len(seen["reduced"]) == 3

@@ -45,6 +45,42 @@ def test_top1_uncapped_equals_selected_expert():
     assert 0.9 < float(jnp.sum(aux)) < E + 0.1
 
 
+def test_aux_loss_pins_gshard_topk_formula():
+    """Pin the load-balance objective: aux = E * sum_e(load_e * imp_e)
+    where load counts ALL top-k routed choices (GShard variant) — NOT the
+    top-1-only load of Switch-style routers.  A deliberate divergence
+    (PARITY.md EP row): with top-1 load, second choices can pile onto one
+    expert without moving the loss.  This test recomputes the formula from
+    the extracted router params so a silent formula change fails loudly."""
+    E, D, F, B, S, K = 4, 16, 32, 2, 16, 2
+    layer = MoELayer(
+        embed_dim=D, ffn_embed_dim=F, num_experts=E, top_k=K,
+        capacity_factor=float(E),
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    params = layer.init({"params": jax.random.PRNGKey(1)}, x)
+    _, mod = layer.apply(params, x, mutable=("losses",))
+    aux = float(jnp.sum(jax.tree_util.tree_leaves(mod["losses"])[0]))
+
+    p = params["params"]
+    tokens = x.reshape(-1, D)
+    probs = jax.nn.softmax(
+        tokens @ p["router"]["kernel"] + p["router"]["bias"], axis=-1
+    )
+    _, idx = jax.lax.top_k(probs, K)                      # (N, K)
+    sel = jax.nn.one_hot(idx, E, dtype=jnp.float32).sum(1)  # ALL k choices
+    load = sel.mean(0) / K
+    importance = probs.mean(0)
+    expect = float(E * jnp.sum(load * importance))
+    assert abs(aux - expect) < 1e-5, (aux, expect)
+
+    # and it differs from the top-1-only load formula on this input,
+    # i.e. the test genuinely discriminates the two variants
+    load1 = jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32).mean(0)
+    top1_aux = float(E * jnp.sum(load1 * importance))
+    assert abs(aux - top1_aux) > 1e-6
+
+
 def test_capacity_drops_overflow_tokens():
     """A capacity of ~one token per expert must zero most tokens' outputs
     (they fall through to the residual in the encoder layer)."""

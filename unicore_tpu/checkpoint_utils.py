@@ -334,19 +334,64 @@ def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
     Transparently reads either this framework's pickle format or a torch
     ``.pt`` checkpoint (converted on the fly via :func:`torch_to_pytree`).
     """
-    with open(path, "rb") as f:
-        magic = f.read(2)
-    if magic == b"PK":  # torch >= 1.6 zipfile format
+    import sys
+
+    if detect_checkpoint_format(path) == "torch":
         state = load_torch_checkpoint(path)
     else:
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+        torch_was_loaded = "torch" in sys.modules
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+            if not isinstance(state, dict):
+                raise ValueError(
+                    f"not a checkpoint dict: {type(state).__name__}"
+                )
+        except Exception as pickle_err:
+            # mis-sniffed torch file (e.g. legacy stream written with a
+            # non-default pickle protocol): give torch.load one chance, but
+            # if that fails too, surface the ORIGINAL pickle error — a
+            # corrupt native checkpoint must not masquerade as a torch
+            # problem (or as "torch missing" on torch-less hosts)
+            try:
+                state = load_torch_checkpoint(path)
+            except Exception:
+                raise pickle_err from None
+        else:
+            # A dict pickled with torch tensors inside (plain-pickled torch
+            # state) still needs the numpy conversion.  Unpickling such
+            # tensors imports torch, so torch newly appearing in
+            # sys.modules proves they exist; if torch was already imported
+            # for unrelated reasons, scan for actual tensor leaves rather
+            # than rebuilding every native checkpoint's tree.
+            if "torch" in sys.modules and (
+                not torch_was_loaded or _has_torch_tensors(state)
+            ):
+                state = torch_to_pytree(state)
 
     if "args" in state and state["args"] is not None and arg_overrides is not None:
         args = state["args"]
         for arg_name, arg_val in arg_overrides.items():
             setattr(args, arg_name, arg_val)
     return state
+
+
+# legacy (pre-1.6) torch files open with a pickled magic-number long;
+# its 10-byte little-endian payload is a fixed signature in the header
+_LEGACY_TORCH_MAGIC = (0x1950A86A20F9469CFC6C).to_bytes(10, "little")
+
+
+def detect_checkpoint_format(path) -> str:
+    """``"torch"`` or ``"pickle"``, from the file header only (no
+    unpickling — a native checkpoint can be multi-GB).  torch >= 1.6
+    zipfiles carry the b'PK' magic; LEGACY torch files start with a pickle
+    of torch's magic-number long, whose byte payload can't open a genuine
+    state-dict pickle."""
+    with open(path, "rb") as f:
+        head = f.read(32)
+    if head[:2] == b"PK" or _LEGACY_TORCH_MAGIC in head:
+        return "torch"
+    return "pickle"
 
 
 def load_torch_checkpoint(path):
@@ -412,8 +457,27 @@ def torch_to_pytree(obj):
     if isinstance(obj, dict):
         return {k: torch_to_pytree(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return type(obj)(torch_to_pytree(v) for v in obj)
+        vals = [torch_to_pytree(v) for v in obj]
+        if isinstance(obj, tuple):
+            # namedtuples take positional fields, not an iterable
+            cls = type(obj)
+            return cls(*vals) if hasattr(obj, "_fields") else cls(vals)
+        return type(obj)(vals)
     return obj
+
+
+def _has_torch_tensors(obj) -> bool:
+    """True if any leaf of a dict/list/tuple tree is a torch.Tensor.
+    Only called when torch is already imported (cheap tree walk)."""
+    import torch
+
+    if isinstance(obj, torch.Tensor):
+        return True
+    if isinstance(obj, dict):
+        return any(_has_torch_tensors(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_has_torch_tensors(v) for v in obj)
+    return False
 
 
 def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):

@@ -93,14 +93,34 @@ def get_data_parallel_group():
 
 
 def get_data_parallel_rank() -> int:
-    """Rank of this host's first device in the (default all-device) data
-    group — consistent with :func:`get_data_parallel_world_size` counting
-    devices, not hosts.  Meshed trainers use ``Trainer.data_parallel_rank``,
-    which accounts for non-data mesh axes."""
-    return jax.process_index() * jax.local_device_count()
+    """This PROCESS's rank among data-parallel workers — the reference's
+    meaning (utils.py:226: one process per GPU, rank == process rank), kept
+    so user-dir plugins doing ``rank == 0`` guards or
+    ``data[rank::world_size]`` arithmetic against
+    :func:`get_data_parallel_world_size` keep working.  Device-granular
+    sharding (a JAX process drives several chips) lives in the explicitly
+    named :func:`get_data_parallel_shard_index` /
+    :func:`get_data_parallel_num_shards` pair; meshed trainers use
+    ``Trainer.data_parallel_rank``, which also accounts for non-data mesh
+    axes."""
+    return jax.process_index()
 
 
 def get_data_parallel_world_size() -> int:
+    """Number of data-parallel worker PROCESSES (pairs with
+    :func:`get_data_parallel_rank`)."""
+    return jax.process_count()
+
+
+def get_data_parallel_shard_index() -> int:
+    """Index of this process's FIRST device among all data-parallel device
+    shards (device-granular; pairs with
+    :func:`get_data_parallel_num_shards`)."""
+    return jax.process_index() * jax.local_device_count()
+
+
+def get_data_parallel_num_shards() -> int:
+    """Total data-parallel device shards (device-granular)."""
     return jax.device_count()
 
 

@@ -1,18 +1,17 @@
 """BERT masked-LM task
-(reference /root/reference/examples/bert/task.py — bundled as a built-in so
-the framework trains end-to-end out of the box; examples/bert shows the
---user-dir plugin route).
+(capability parity with /root/reference/examples/bert/task.py — bundled as a
+built-in so the framework trains end-to-end out of the box; examples/bert
+shows the --user-dir plugin route).
 
-Pipeline parity: raw text (LMDB or this framework's native indexed shards)
--> WordPiece tokenize -> BERT masking -> right-pad-to-multiple-of-8 ->
-nested-dict batches, epoch-shuffled via SortDataset over a seeded
-permutation.
+Pipeline: raw text (this framework's native indexed shards, or LMDB)
+-> WordPiece tokenize -> BERT masking -> right-pad to a kernel-friendly
+multiple -> nested-dict batches.  Unlike the reference (one fixed
+permutation for the life of the run), the train split reshuffles every
+epoch, deterministically in (seed, epoch) so resume reproduces the order.
 """
 
 import logging
 import os
-
-import numpy as np
 
 from unicore_tpu.data import (
     BertTokenizeDataset,
@@ -20,11 +19,7 @@ from unicore_tpu.data import (
     EpochShuffleDataset,
     MaskTokensDataset,
     NestedDictionaryDataset,
-    NumSamplesDataset,
-    NumelDataset,
     RightPadDataset,
-    SortDataset,
-    data_utils,
 )
 from unicore_tpu.data.indexed_dataset import IndexedPickleDataset
 from unicore_tpu.data.lmdb_dataset import LMDBDataset, _HAS_LMDB
@@ -84,7 +79,6 @@ class BertTask(UnicoreTask):
         super().__init__(args)
         self.dictionary = dictionary
         self.seed = args.seed
-        # add mask token
         self.mask_idx = dictionary.add_symbol("[MASK]", is_special=True)
 
     @classmethod
@@ -93,45 +87,40 @@ class BertTask(UnicoreTask):
         logger.info(f"dictionary: {len(dictionary)} types")
         return cls(args, dictionary)
 
-    def load_dataset(self, split, combine=False, **kwargs):
-        split_path = os.path.join(self.args.data, split)
-        dict_path = os.path.join(self.args.data, "dict.txt")
-
-        dataset = open_text_dataset(split_path)
-        dataset = BertTokenizeDataset(
-            dataset, dict_path, max_seq_len=self.args.max_seq_len
+    def _padded(self, dataset):
+        """Right-pad view with this task's pad token, rounded up to
+        --seq-pad-multiple so every batch lands on kernel-aligned widths."""
+        return RightPadDataset(
+            dataset,
+            pad_idx=self.dictionary.pad(),
+            pad_to_multiple=self.args.seq_pad_multiple,
         )
 
-        src_dataset, tgt_dataset = MaskTokensDataset.apply_mask(
-            dataset,
+    def load_dataset(self, split, combine=False, **kwargs):
+        a = self.args
+        tokens = BertTokenizeDataset(
+            open_text_dataset(os.path.join(a.data, split)),
+            os.path.join(a.data, "dict.txt"),
+            max_seq_len=a.max_seq_len,
+        )
+        masked, labels = MaskTokensDataset.apply_mask(
+            tokens,
             self.dictionary,
             pad_idx=self.dictionary.pad(),
             mask_idx=self.mask_idx,
-            seed=self.args.seed,
-            mask_prob=self.args.mask_prob,
-            leave_unmasked_prob=self.args.leave_unmasked_prob,
-            random_token_prob=self.args.random_token_prob,
+            seed=a.seed,
+            mask_prob=a.mask_prob,
+            leave_unmasked_prob=a.leave_unmasked_prob,
+            random_token_prob=a.random_token_prob,
         )
-
-        with data_utils.numpy_seed(self.args.seed):
-            shuffle = np.random.permutation(len(src_dataset))
-
-        self.datasets[split] = SortDataset(
-            NestedDictionaryDataset(
-                {
-                    "net_input": {
-                        "src_tokens": RightPadDataset(
-                            src_dataset,
-                            pad_idx=self.dictionary.pad(),
-                            pad_to_multiple=self.args.seq_pad_multiple,
-                        )
-                    },
-                    "target": RightPadDataset(
-                        tgt_dataset,
-                        pad_idx=self.dictionary.pad(),
-                        pad_to_multiple=self.args.seq_pad_multiple,
-                    ),
-                },
-            ),
-            sort_order=[shuffle],
+        batches = NestedDictionaryDataset(
+            {
+                "net_input": {"src_tokens": self._padded(masked)},
+                "target": self._padded(labels),
+            }
         )
+        if split == "train":
+            # (seed, epoch)-keyed reshuffle each epoch; eval splits stay in
+            # corpus order (their iterators run shuffle=False anyway)
+            batches = EpochShuffleDataset(batches, len(batches), self.seed)
+        self.datasets[split] = batches

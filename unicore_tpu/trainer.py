@@ -867,7 +867,11 @@ class Trainer(object):
             return None
         out = self._get_jit("valid_step")(params, sample, scalars, None)
         out.pop("_n", None)
-        return out
+        # weight-0 dummy (shard-tail alignment) batches still RUN the step —
+        # multi-host collectives must stay aligned — but their all-zero
+        # logging output is not a real batch: per-batch collectors
+        # (non-summable losses) must not see it
+        return None if weight == 0.0 else out
 
     def finish_valid_accum(self):
         """Fetch-and-reset the validation accumulator: the summed logging

@@ -374,10 +374,14 @@ def validate(args, trainer, task, epoch_itr, subsets: List[str]) -> List[Optiona
 
     Per-batch logging outputs accumulate ON DEVICE (trainer.valid_step with
     ``accumulate=True``); the host fetches the summed totals once per
-    subset instead of once per batch."""
+    subset instead of once per batch.  Losses that declare their eval
+    logging outputs non-summable (``logging_outputs_can_be_summed(False)``)
+    opt out: their outputs are collected per batch and handed to
+    ``reduce_metrics`` unsummed, matching the reference's list semantics."""
     from unicore_tpu.logging import metrics
 
     fixed_seed = args.fixed_validation_seed  # None -> step-keyed eval rng
+    summable = task.logging_outputs_can_be_summed(trainer.loss, is_train=False)
 
     trainer.begin_valid_epoch(epoch_itr.epoch)
     results = []
@@ -392,12 +396,19 @@ def validate(args, trainer, task, epoch_itr, subsets: List[str]) -> List[Optiona
 
         # separate metrics root: validation must not bleed into train meters
         with metrics.aggregate(new_root=True) as agg:
+            per_batch = []
             for i, sample in enumerate(progress):
                 if args.max_valid_steps is not None and i > args.max_valid_steps:
                     break
-                trainer.valid_step(sample, seed=fixed_seed, accumulate=True)
-            totals = trainer.finish_valid_accum()
-            task.reduce_metrics([totals] if totals else [], trainer.loss, subset)
+                out = trainer.valid_step(
+                    sample, seed=fixed_seed, accumulate=summable
+                )
+                if not summable and out is not None:
+                    per_batch.append(out)
+            if summable:
+                totals = trainer.finish_valid_accum()
+                per_batch = [totals] if totals else []
+            task.reduce_metrics(per_batch, trainer.loss, subset)
 
         stats = _finalize_valid_stats(args, trainer, agg.get_smoothed_values())
         progress.print(stats, tag=subset, step=trainer.get_num_updates())
