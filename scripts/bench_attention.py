@@ -32,6 +32,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# honor the standard platform override BEFORE any jax import — without it a
+# dead axon tunnel hangs the jax.devices() probe below instead of running
+# the interpret-mode smoke
+if os.environ.get("UNICORE_TPU_PLATFORM", "").lower() == "cpu":
+    from unicore_tpu.platform_utils import force_host_cpu
+
+    force_host_cpu(int(os.environ.get("UNICORE_TPU_CPU_DEVICES", "1")))
+
 REPS = int(os.environ.get("BENCH_ATTN_REPS", "30"))
 PARTIAL = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -88,16 +96,18 @@ def main():
           file=sys.stderr)
 
     # (name, B, H, L, D, bias_mode) — the bundled families' hot shapes.
-    # bias_mode: None, 'shared' ((1,H,L,L) broadcast — rel-pos style), or
-    # 'per_batch' ((B,H,L,L) — the MATERIALIZED form of evoformer's grouped
-    # MSA-row bias; timing flash-with-per-batch-bias vs xla on it is the
-    # go/no-go data for a grouped-bias kernel extension, which would read
-    # each of the 8 distinct groups once instead of B copies).
+    # bias_mode: None, 'shared' ((1,H,L,L) broadcast — rel-pos style),
+    # 'per_batch' ((B,H,L,L)), or 'grouped' ((8,H,L,L) with B % 8 == 0 —
+    # the REAL evoformer MSA-row layout: runs of B/8 rows share a slab,
+    # indexed in-kernel since round 4; per_batch is kept as the
+    # materialized-form comparison row).
     configs = [
         ("bert_seq512", 16, 12, 512, 64, None),
         ("bert_seq256", 32, 12, 256, 64, None),
         ("unimol_pair_seq256", 16, 8, 256, 64, "shared"),
-        ("evoformer_msarow_seq256", 256, 8, 256, 32, "per_batch"),
+        ("evoformer_msarow_seq256", 256, 8, 256, 32, "grouped"),
+        ("evoformer_msarow_seq256_materialized", 256, 8, 256, 32,
+         "per_batch"),
     ]
     flash_blocks = [(128, 128), (128, 256), (256, 256), (256, 512),
                     (512, 512)]
@@ -115,7 +125,7 @@ def main():
         )
         bias = None
         if bias_mode is not None:
-            bias_b = 1 if bias_mode == "shared" else B
+            bias_b = {"shared": 1, "grouped": min(8, B)}.get(bias_mode, B)
             bias = jax.random.normal(
                 jax.random.fold_in(key, 7), (bias_b, H, L, L), jnp.float32
             )

@@ -6,11 +6,11 @@ pattern is exactly what its fused softmax kernel's bias-broadcast mode exists
 for (reference tests/test_softmax.py:81-170).  This module family provides
 the same computational blocks TPU-natively:
 
-- gated multi-head attention over arbitrary leading batch dims via the
-  XLA-fused softmax path (the L here is <=256 and the pair bias varies per
-  leading dim, outside the Pallas kernels' (1|B, 1|H, L, L) bias layout —
-  extending the kernels' bias broadcast to grouped leading dims is the
-  known follow-up, gated on on-TPU measurement);
+- gated multi-head attention over arbitrary leading batch dims, routed
+  through the Pallas flash kernel with GROUPED bias broadcast (bias slab
+  per leading group, indexed in-kernel — ops/flash_attention.py) whenever
+  shapes tile; the L x L probability matrix then never reaches HBM.  The
+  XLA softmax path remains as fallback for non-128-multiple L;
 - MSA row attention with pair bias, MSA column attention;
 - outer-product-mean MSA -> pair update;
 - triangle multiplication (outgoing/incoming) and triangle attention
@@ -37,8 +37,16 @@ from .transformer_encoder import bert_init
 class GatedAttention(nn.Module):
     """AF2-style gated MHA: out = Linear(sigmoid(gate) * attn(v)).
 
-    Inputs may have arbitrary leading dims: (*B, Lq, D_q) x (*B, Lk, D_kv);
-    ``bias`` broadcastable to (*B, H, Lq, Lk).
+    Inputs may have arbitrary leading dims: (*B, Lq, D_q) x (*B, Lk, D_kv).
+    ``bias`` is GROUPED over the flattened leading dims: shape
+    (G, 1|H, Lq, Lk) with prod(lead) % G == 0 — consecutive runs of
+    prod(lead)/G rows (the MSA rows of one sequence, the lead rows of one
+    pair matrix) share a bias slab.  ``kv_mask`` (*B, Lk), 1 = valid.
+
+    When shapes allow, the whole attention runs in the Pallas flash kernel
+    with the grouped bias indexed in-kernel — the L x L probability matrix
+    never reaches HBM (the reference fuses softmax+mask+bias around a
+    materialized matrix instead, csrc/softmax_dropout/interface.cpp:37-48).
     """
 
     embed_dim: int
@@ -46,10 +54,24 @@ class GatedAttention(nn.Module):
     gating: bool = True
 
     @nn.compact
-    def __call__(self, q_x, kv_x, bias: Optional[jnp.ndarray] = None):
+    def __call__(
+        self,
+        q_x,
+        kv_x,
+        bias: Optional[jnp.ndarray] = None,
+        kv_mask: Optional[jnp.ndarray] = None,
+    ):
         head_dim = self.embed_dim // self.num_heads
         scale = head_dim ** -0.5
         H = self.num_heads
+        if bias is not None and bias.ndim != 4:
+            raise ValueError(
+                f"GatedAttention bias must be GROUPED 4-d (G, 1|H, Lq, Lk) "
+                f"over the flattened leading dims, got shape {bias.shape}; "
+                "pre-broadcast layouts (e.g. (B, 1, H, L, L)) were retired "
+                "when attention moved into the flash kernel — pass the "
+                "group slab and the padding mask (kv_mask=) separately"
+            )
 
         dense = partial(
             nn.Dense, use_bias=False, kernel_init=bert_init,
@@ -67,9 +89,45 @@ class GatedAttention(nn.Module):
 
         q, k, v = split(q, Lq), split(k, Lk), split(v, Lk)  # (*B, H, L, hd)
 
-        s = jnp.einsum("...hqd,...hkd->...hqk", q, k)
-        probs = softmax_dropout(s, 0.0, is_training=False, bias=bias)
-        o = jnp.einsum("...hqk,...hkd->...hqd", probs, v)
+        N = 1
+        for d in lead:
+            N *= d
+        if _flash_ok(N, Lq, Lk, head_dim, q.dtype, bias):
+            from unicore_tpu.ops.flash_attention import flash_attention
+
+            kvm = None
+            if kv_mask is not None:
+                # kernel semantics: nonzero = masked OUT
+                kvm = 1 - kv_mask.reshape(N, Lk).astype(jnp.int32)
+            o = flash_attention(
+                q.reshape(N, H, Lq, head_dim),
+                k.reshape(N, H, Lk, head_dim),
+                v.reshape(N, H, Lk, head_dim),
+                bias=bias,
+                kv_padding_mask=kvm,
+                sm_scale=1.0,  # q is pre-scaled
+            ).reshape(*lead, H, Lq, head_dim)
+        else:
+            s = jnp.einsum("...hqd,...hkd->...hqk", q, k)
+            if bias is not None:
+                G = bias.shape[0]
+                b5 = bias[:, None]  # (G, 1, 1|H, Lq, Lk)
+                if kv_mask is not None:
+                    b5 = b5 + mask_to_bias(kv_mask).reshape(
+                        G, N // G, 1, 1, Lk
+                    )
+                probs = softmax_dropout(
+                    s.reshape(G, N // G, H, Lq, Lk), 0.0,
+                    is_training=False, bias=b5,
+                ).reshape(s.shape)
+            elif kv_mask is not None:
+                probs = softmax_dropout(
+                    s, 0.0, is_training=False,
+                    bias=mask_to_bias(kv_mask)[..., None, None, :],
+                )
+            else:
+                probs = softmax_dropout(s, 0.0, is_training=False)
+            o = jnp.einsum("...hqk,...hkd->...hqd", probs, v)
         o = o.swapaxes(-2, -3).reshape(*lead, Lq, self.embed_dim)
 
         if self.gating:
@@ -93,6 +151,26 @@ def mask_to_bias(mask, dtype=jnp.float32):
     return (mask.astype(jnp.float32) - 1.0) * 1e9
 
 
+def _flash_ok(N, Lq, Lk, head_dim, dtype, bias):
+    """Gate for routing GatedAttention through the Pallas flash kernel:
+    TPU (or interpret mode under test), kernel-tileable shapes, and a bias
+    whose group count divides the flattened batch.  Dropout never gates —
+    this module family applies dropout OUTSIDE attention (AF2 drop_row)."""
+    from unicore_tpu.ops._pallas import interpret_enabled
+
+    backend_ok = (
+        jax.default_backend() in ("tpu", "axon") or interpret_enabled()
+    )
+    return (
+        backend_ok
+        and Lq % 128 == 0
+        and Lk % 128 == 0
+        and head_dim % 8 == 0
+        and dtype in (jnp.float32, jnp.bfloat16)
+        and (bias is None or N % bias.shape[0] == 0)
+    )
+
+
 class MSARowAttentionWithPairBias(nn.Module):
     """Attention along the residue dim of each MSA row, biased by the pair
     representation."""
@@ -111,11 +189,12 @@ class MSARowAttentionWithPairBias(nn.Module):
             kernel_init=nn.initializers.normal(1.0 / (self.pair_dim ** 0.5)),
             dtype=msa.dtype, param_dtype=jnp.float32,
         )(z)  # (B, L, L, H)
-        bias = pair_bias.transpose(0, 3, 1, 2)[:, None]  # (B, 1, H, L, L)
-        if msa_mask is not None:
-            bias = bias + mask_to_bias(msa_mask)[:, :, None, None, :]
+        # grouped bias: all R rows of sequence b share slab b; the padding
+        # mask rides separately so the kernel path never materializes the
+        # per-row (B, R, H, L, L) combined bias the old layout implied
+        bias = pair_bias.transpose(0, 3, 1, 2)  # (B, H, L, L)
         out = GatedAttention(self.embed_dim, self.num_heads, name="attn")(
-            m, m, bias=bias
+            m, m, bias=bias, kv_mask=msa_mask
         )
         return out
 
@@ -130,12 +209,9 @@ class MSAColumnAttention(nn.Module):
     def __call__(self, msa, msa_mask=None):
         m = LayerNorm(self.embed_dim, name="ln_m")(msa)
         mt = m.swapaxes(1, 2)  # (B, L, R, D)
-        bias = None
-        if msa_mask is not None:
-            col_mask = msa_mask.swapaxes(1, 2)  # (B, L, R)
-            bias = mask_to_bias(col_mask)[:, :, None, None, :]
+        col_mask = msa_mask.swapaxes(1, 2) if msa_mask is not None else None
         out = GatedAttention(self.embed_dim, self.num_heads, name="attn")(
-            mt, mt, bias=bias
+            mt, mt, kv_mask=col_mask
         )
         return out.swapaxes(1, 2)
 
@@ -239,12 +315,13 @@ class TriangleAttention(nn.Module):
             kernel_init=nn.initializers.normal(1.0 / (self.pair_dim ** 0.5)),
             dtype=z.dtype, param_dtype=jnp.float32,
         )(z)  # (B, I, J, H)
-        bias = tri_bias.transpose(0, 3, 1, 2)[:, None]  # (B,1,H,I,J)
+        # grouped bias: every lead row i of pair matrix b shares slab b
+        bias = tri_bias.transpose(0, 3, 1, 2)  # (B, H, I, J)
+        pm = None
         if pair_mask is not None:
             pm = pair_mask if self.starting else pair_mask.swapaxes(1, 2)
-            bias = bias + mask_to_bias(pm)[:, :, None, None, :]
         out = GatedAttention(self.pair_dim, self.num_heads, name="attn")(
-            z, z, bias=bias
+            z, z, bias=bias, kv_mask=pm
         )
         return out if self.starting else out.swapaxes(1, 2)
 

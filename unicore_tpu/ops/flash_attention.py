@@ -10,9 +10,13 @@ the L x L matrix to HBM), which removes the reference's dominant HBM
 bandwidth cost and its O(L^2) activation memory.
 
 Capabilities (superset of the reference kernel's semantics):
-- additive bias broadcast over batch — shapes (1|B, H|1, Lq, Lk); bias
-  gradient is summed over the broadcast dims inside a dedicated kernel
-  (the reference does this sum in Python, modules/softmax_dropout.py:44-48)
+- additive bias with GROUPED batch broadcast — (Bb, H|1, Lq, Lk) for any
+  Bb dividing B, batch b reading group b // (B/Bb): covers shared (Bb=1),
+  per-batch (Bb=B), and the Evoformer MSA-row/triangle layout in between
+  (the reference kernel's broadcast mode, csrc/softmax_dropout/
+  interface.cpp:37-48); bias gradient is summed over the broadcast dims
+  inside a dedicated kernel (the reference does this sum in Python,
+  modules/softmax_dropout.py:44-48)
 - key-padding mask (B, Lk), applied additively AND multiplicatively so fully
   masked rows produce zeros, not NaN
 - attention dropout inside the kernel: the bit-mask is regenerated from a
@@ -143,9 +147,20 @@ def _fwd_kernel(
         lse_ref[0, 0] = lse.astype(jnp.float32)  # (BQ, 1)
 
 
-def _bias_index(Bb, Hb):
+def _bias_index(B, Bb, Hb):
+    """Grouped-broadcast bias indexing: batch b reads bias group b // (B/Bb).
+
+    Bb == 1 (one shared bias) and Bb == B (per-batch bias) are the
+    degenerate cases; 1 < Bb < B is the Evoformer/Uni-Fold layout, where
+    consecutive runs of B/Bb flattened batches (MSA rows of one sequence,
+    lead rows of one pair matrix) share a pair-bias slab — the same
+    broadcast contract as the reference kernel
+    (/root/reference/csrc/softmax_dropout/interface.cpp:37-48).
+    """
+    gb = B // Bb
+
     def idx(b, h, iq, ik, *_):
-        return (b if Bb > 1 else 0, h if Hb > 1 else 0, iq, ik)
+        return (b // gb, h if Hb > 1 else 0, iq, ik)
 
     return idx
 
@@ -168,7 +183,7 @@ def _fwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q, block_k)
     if has_bias:
         Bb, Hb = bias.shape[0], bias.shape[1]
         in_specs.append(
-            pl.BlockSpec((1, 1, BQ, BK), _bias_index(Bb, Hb))
+            pl.BlockSpec((1, 1, BQ, BK), _bias_index(B, Bb, Hb))
         )
         inputs.append(bias)
     if has_mask:
@@ -350,13 +365,16 @@ def _db_kernel(
     q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref, do_ref,
     db_ref,
     db_s,
-    *, sm_scale, dropout_rate, nb, has_bias, has_mask,
+    *, sm_scale, dropout_rate, nr, has_bias, has_mask,
 ):
-    # grid (H, nq, nk, B): batch innermost so the bias-grad block stays
-    # resident in VMEM while the broadcast batch dim is reduced
-    h, iq, ik, b = (pl.program_id(i) for i in range(4))
+    # grid (Bb, H, nq, nk, R) with R = B // Bb innermost: each bias group's
+    # grad block stays resident in VMEM while its R broadcast batches are
+    # reduced.  R == B (one shared bias) and R == 1 (per-batch bias, ds IS
+    # the grad) are the degenerate ends of the same loop.
+    g, h, iq, ik, r = (pl.program_id(i) for i in range(5))
+    b = g * nr + r  # the flat batch this tick visits (dropout stream key)
 
-    @pl.when(b == 0)
+    @pl.when(r == 0)
     def _init():
         db_s[...] = jnp.zeros_like(db_s)
 
@@ -368,7 +386,7 @@ def _db_kernel(
     )
     db_s[...] += ds
 
-    @pl.when(b == nb - 1)
+    @pl.when(r == nr - 1)
     def _finish():
         db_ref[0, 0] = db_s[...].astype(db_ref.dtype)
 
@@ -378,24 +396,31 @@ def _bwd_inputs(q, k, v, bias, kv_mask, lse, di, do, BQ, BK, *, kv_major):
 
     ``kv_major=False``: grid (B, H, nq, nk); True: grid (B, H, nk, nq).
     """
+    B = q.shape[0]
     if kv_major:
         qi, ki = (lambda b, h, ik, iq, *_: (b, h, iq, 0)), (
             lambda b, h, ik, iq, *_: (b, h, ik, 0)
         )
         rowi = lambda b, h, ik, iq, *_: (b, h, iq, 0)
         maski = lambda b, h, ik, iq, *_: (b, 0, ik)
-        bi = lambda Bb, Hb: (
-            lambda b, h, ik, iq, *_: (b if Bb > 1 else 0, h if Hb > 1 else 0, iq, ik)
-        )
+
+        def bi(Bb, Hb):
+            gb = B // Bb
+            return lambda b, h, ik, iq, *_: (
+                b // gb, h if Hb > 1 else 0, iq, ik
+            )
     else:
         qi, ki = (lambda b, h, iq, ik, *_: (b, h, iq, 0)), (
             lambda b, h, iq, ik, *_: (b, h, ik, 0)
         )
         rowi = lambda b, h, iq, ik, *_: (b, h, iq, 0)
         maski = lambda b, h, iq, ik, *_: (b, 0, ik)
-        bi = lambda Bb, Hb: (
-            lambda b, h, iq, ik, *_: (b if Bb > 1 else 0, h if Hb > 1 else 0, iq, ik)
-        )
+
+        def bi(Bb, Hb):
+            gb = B // Bb
+            return lambda b, h, iq, ik, *_: (
+                b // gb, h if Hb > 1 else 0, iq, ik
+            )
 
     D = q.shape[-1]
     specs = [
@@ -512,110 +537,78 @@ def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q,
     )(seed, *inputs)
 
     # ---- dbias -------------------------------------------------------
+    # One kernel for every broadcast layout: grid (Bb, H, nq, nk, R) with
+    # R = B // Bb batches reduced in VMEM per bias group.  Bb == 1 is the
+    # classic shared-bias reduction, Bb == B degenerates to "ds IS the
+    # grad", and 1 < Bb < B is the grouped Evoformer layout.
     dbias = None
     if has_bias:
         Bb, Hb = bias.shape[0], bias.shape[1]
-        if Bb == 1:
-            # reduce the broadcast batch dim inside the kernel:
-            # grid (H, nq, nk, B) with batch innermost
-            inputs, _ = _bwd_inputs(
-                q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
-            )
-            db_specs = [
-                pl.BlockSpec((1, 1, BQ, D), lambda h, iq, ik, b, *_: (b, h, iq, 0)),
-                pl.BlockSpec((1, 1, BK, D), lambda h, iq, ik, b, *_: (b, h, ik, 0)),
-                pl.BlockSpec((1, 1, BK, D), lambda h, iq, ik, b, *_: (b, h, ik, 0)),
-            ]
+        assert Hb == H or Hb == 1
+        R = B // Bb
+        inputs, _ = _bwd_inputs(
+            q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
+        )
+
+        def bat(g, r):
+            return g * R + r
+
+        db_specs = [
+            pl.BlockSpec((1, 1, BQ, D),
+                         lambda g, h, iq, ik, r, *_: (bat(g, r), h, iq, 0)),
+            pl.BlockSpec((1, 1, BK, D),
+                         lambda g, h, iq, ik, r, *_: (bat(g, r), h, ik, 0)),
+            pl.BlockSpec((1, 1, BK, D),
+                         lambda g, h, iq, ik, r, *_: (bat(g, r), h, ik, 0)),
+            pl.BlockSpec(
+                (1, 1, BQ, BK),
+                lambda g, h, iq, ik, r, *_: (g, h if Hb > 1 else 0, iq, ik),
+            ),
+        ]
+        if has_mask:
             db_specs.append(
-                pl.BlockSpec(
-                    (1, 1, BQ, BK),
-                    lambda h, iq, ik, b, *_: (0, h if Hb > 1 else 0, iq, ik),
-                )
+                pl.BlockSpec((1, 1, BK),
+                             lambda g, h, iq, ik, r, *_: (bat(g, r), 0, ik))
             )
-            if has_mask:
-                db_specs.append(
-                    pl.BlockSpec((1, 1, BK), lambda h, iq, ik, b, *_: (b, 0, ik))
-                )
-            db_specs.append(
-                pl.BlockSpec((1, 1, BQ, 1), lambda h, iq, ik, b, *_: (b, h, iq, 0))
-            )
-            db_specs.append(
-                pl.BlockSpec((1, 1, BQ, 1), lambda h, iq, ik, b, *_: (b, h, iq, 0))
-            )
-            db_specs.append(
-                pl.BlockSpec((1, 1, BQ, D), lambda h, iq, ik, b, *_: (b, h, iq, 0))
+        db_specs.extend([
+            pl.BlockSpec((1, 1, BQ, 1),
+                         lambda g, h, iq, ik, r, *_: (bat(g, r), h, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, 1),
+                         lambda g, h, iq, ik, r, *_: (bat(g, r), h, iq, 0)),
+            pl.BlockSpec((1, 1, BQ, D),
+                         lambda g, h, iq, ik, r, *_: (bat(g, r), h, iq, 0)),
+        ])
+
+        def db_wrapped(seed_ref, *refs):
+            in_refs, outs, scratch = unpack(refs, len(inputs))
+            _db_kernel(
+                seed_ref, *in_refs, *outs, *scratch,
+                sm_scale=sm_scale, dropout_rate=dropout_rate, nr=R,
+                has_bias=has_bias, has_mask=has_mask,
             )
 
-            def db_wrapped(seed_ref, *refs):
-                in_refs, outs, scratch = unpack(refs, len(inputs))
-                _db_kernel(
-                    seed_ref, *in_refs, *outs, *scratch,
-                    sm_scale=sm_scale, dropout_rate=dropout_rate, nb=B,
-                    has_bias=has_bias, has_mask=has_mask,
-                )
-
-            assert Hb == H or Hb == 1
-            # Hb == 1: the kernel writes per-head grads; reduced below
-            dbias_full = _pallas_call(
-                db_wrapped,
-                grid_spec=pltpu.PrefetchScalarGridSpec(
-                    num_scalar_prefetch=1,
-                    grid=(H, nq, nk, B),
-                    in_specs=db_specs,
-                    out_specs=[
-                        pl.BlockSpec(
-                            (1, 1, BQ, BK), lambda h, iq, ik, b, *_: (0, h, iq, ik)
-                        ),
-                    ],
-                    scratch_shapes=[pltpu.VMEM((BQ, BK), jnp.float32)],
-                ),
-                out_shape=[
-                    jax.ShapeDtypeStruct((1, H, Lq, Lk), jnp.float32)
+        # Hb == 1: the kernel writes per-head grads; reduced below
+        dbias_full = _pallas_call(
+            db_wrapped,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(Bb, H, nq, nk, R),
+                in_specs=db_specs,
+                out_specs=[
+                    pl.BlockSpec(
+                        (1, 1, BQ, BK),
+                        lambda g, h, iq, ik, r, *_: (g, h, iq, ik),
+                    ),
                 ],
-            )(seed, *inputs)[0]
-            if Hb == 1:
-                dbias_full = jnp.sum(dbias_full, axis=1, keepdims=True)
-            dbias = dbias_full.astype(bias.dtype)
-        else:
-            # per-batch bias: ds IS the bias grad; emit it from a dq-shaped
-            # pass (same recompute, full-size output)
-            inputs, specs = _bwd_inputs(
-                q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
-            )
-
-            def ds_wrapped(seed_ref, *refs):
-                in_refs, outs, _ = unpack(refs, len(inputs))
-                (q_ref, k_ref, v_ref, bias_ref, mask_ref, lse_ref, di_ref,
-                 do_ref) = in_refs
-                b, h, iq, ik = (pl.program_id(i) for i in range(4))
-                p, kv_m = _recompute_p(
-                    q_ref, k_ref, bias_ref, mask_ref, lse_ref, sm_scale,
-                    has_bias, has_mask,
-                )
-                ds = _ds_block(
-                    seed_ref, p, kv_m, do_ref, v_ref, di_ref, dropout_rate,
-                    b, h, iq, ik,
-                )
-                outs[0][0, 0] = ds.astype(outs[0].dtype)
-
-            dbias = _pallas_call(
-                ds_wrapped,
-                grid_spec=pltpu.PrefetchScalarGridSpec(
-                    num_scalar_prefetch=1,
-                    grid=(B, H, nq, nk),
-                    in_specs=specs,
-                    out_specs=[
-                        pl.BlockSpec(
-                            (1, 1, BQ, BK), lambda b, h, iq, ik, *_: (b, h, iq, ik)
-                        ),
-                    ],
-                ),
-                out_shape=[
-                    jax.ShapeDtypeStruct((B, H, Lq, Lk), bias.dtype)
-                ],
-            )(seed, *inputs)[0]
-            if bias.shape[1] == 1:
-                dbias = jnp.sum(dbias, axis=1, keepdims=True)
+                scratch_shapes=[pltpu.VMEM((BQ, BK), jnp.float32)],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((Bb, H, Lq, Lk), jnp.float32)
+            ],
+        )(seed, *inputs)[0]
+        if Hb == 1:
+            dbias_full = jnp.sum(dbias_full, axis=1, keepdims=True)
+        dbias = dbias_full.astype(bias.dtype)
 
     return dq, dk, dv, dbias
 
@@ -671,8 +664,15 @@ def flash_attention(
         q, k, v: (B, H, L, D).  L must be a multiple of the block size
             (the module layer pads/unpads; data pipelines already pad to a
             multiple of 8 — use block 128-aligned seq lens for peak speed).
-        bias: additive bias broadcastable as (1|B, 1|H, Lq, Lk); learned
-            biases get correct gradients (broadcast dims reduced in-kernel).
+        bias: additive bias (Bb, 1|H, Lq, Lk) with B % Bb == 0 — GROUPED
+            broadcast: batch b reads bias group b // (B/Bb), so Bb == 1 is
+            one shared bias, Bb == B per-batch, and 1 < Bb < B the
+            Evoformer/Uni-Fold layout (runs of B/Bb consecutive batches —
+            the MSA rows of one sequence — share a pair-bias slab; the
+            reference kernel's broadcast contract,
+            /root/reference/csrc/softmax_dropout/interface.cpp:37-48).
+            Learned biases get correct gradients: every broadcast dim is
+            reduced inside the backward kernel.
         kv_padding_mask: (B, Lk) bool/int; nonzero = masked out.
         dropout_rate: attention dropout applied to the probabilities.
         dropout_seed: int32 seed; fold in step/layer ids for decorrelation.
@@ -681,6 +681,9 @@ def flash_attention(
         if bias.ndim == 3:
             bias = bias[None]
         assert bias.ndim == 4
+        assert q.shape[0] % bias.shape[0] == 0, (
+            f"bias batch {bias.shape[0]} must divide batch {q.shape[0]}"
+        )
     if kv_padding_mask is not None:
         kv_padding_mask = kv_padding_mask.astype(jnp.int32)[:, None, :]
     seed = jnp.reshape(jnp.asarray(dropout_seed, dtype=jnp.int32), (1,))
@@ -697,6 +700,8 @@ def mha_reference(q, k, v, bias=None, kv_padding_mask=None, sm_scale=1.0):
     if bias is not None:
         if bias.ndim == 3:
             bias = bias[None]
+        if bias.shape[0] not in (1, q.shape[0]):  # grouped broadcast
+            bias = jnp.repeat(bias, q.shape[0] // bias.shape[0], axis=0)
         s = s + bias.astype(jnp.float32)
     if kv_padding_mask is not None:
         s = jnp.where(kv_padding_mask[:, None, None, :].astype(bool), NEG_INF, s)
