@@ -64,9 +64,29 @@ def test_flash_grouped_bias_matches_reference(interpret_kernels):
     assert gk[3].shape == (G, H, L, L)
 
 
+def _force_xla_fallback():
+    """Close GatedAttention's kernel gate regardless of backend — on a
+    real TPU `set_interpret(False)` would NOT close it (backend_ok stays
+    true), and the 'fallback' leg would silently rerun the kernel."""
+    import contextlib
+
+    import unicore_tpu.modules.evoformer as evo
+
+    @contextlib.contextmanager
+    def ctx():
+        orig = evo._flash_ok
+        evo._flash_ok = lambda *a, **k: False
+        try:
+            yield
+        finally:
+            evo._flash_ok = orig
+
+    return ctx()
+
+
 def _ga_both_paths(q_x, kv_x, bias, kv_mask, heads):
     """Run GatedAttention once on the kernel route, once on the XLA
-    fallback (interpret toggled off), same params."""
+    fallback (gate forced shut), same params."""
     from unicore_tpu.modules.evoformer import GatedAttention
 
     mod = GatedAttention(q_x.shape[-1], heads)
@@ -77,13 +97,11 @@ def _ga_both_paths(q_x, kv_x, bias, kv_mask, heads):
     def run(p):
         return mod.apply(p, q_x, kv_x, bias, kv_mask)
 
-    fa.set_interpret(True)
     out_kernel = run(params)
     g_kernel = jax.grad(lambda p: jnp.sum(run(p) ** 2))(params)
-    fa.set_interpret(False)  # gate closes -> XLA fallback
-    out_xla = run(params)
-    g_xla = jax.grad(lambda p: jnp.sum(run(p) ** 2))(params)
-    fa.set_interpret(True)
+    with _force_xla_fallback():
+        out_xla = run(params)
+        g_xla = jax.grad(lambda p: jnp.sum(run(p) ** 2))(params)
     return (out_kernel, g_kernel), (out_xla, g_xla)
 
 
@@ -153,11 +171,9 @@ def test_evoformer_iteration_kernel_vs_fallback(interpret_kernels):
         False,
     )
 
-    fa.set_interpret(True)
     m_k, z_k = block.apply(params, msa, pair, msa_mask, pair_mask, False)
-    fa.set_interpret(False)
-    m_x, z_x = block.apply(params, msa, pair, msa_mask, pair_mask, False)
-    fa.set_interpret(True)
+    with _force_xla_fallback():
+        m_x, z_x = block.apply(params, msa, pair, msa_mask, pair_mask, False)
     for a, b in ((m_k, m_x), (z_k, z_x)):
         s = float(jnp.abs(b).max()) + 1e-6
         assert float(jnp.abs(a - b).max()) / s < 2e-4

@@ -324,3 +324,222 @@ def test_trainer_refuses_seq_axis_without_model_support():
     )
     with pytest.raises(ValueError, match="sequence parallelism"):
         Trainer(args, _T(args), model, LOSS_REGISTRY["masked_lm"](_T(args)))
+
+
+def test_ring_inside_pipeline_matches_plain_ring():
+    """dp x pp x sp composition (round-4 verdict #3): pipelining the ring
+    encoder must be a pure LAYOUT change — the GPipe stack with the
+    sequence dim sharded over 'seq' and ring attention running INSIDE the
+    stage shard_map matches the non-pipelined ring encoder, forward and
+    gradients.  (Ring-vs-dense equivalence is covered separately by
+    test_ring_encoder_matches_dense; comparing the pipelined ring against
+    the DENSE path instead would conflate this test with the ring's own
+    fp32 accumulation-order noise, which concentrates in token-summed
+    projection-bias grads.)"""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(data=2, pipe=2, seq=2)
+    set_global_mesh(mesh)
+
+    B, L, E, H, LAYERS = 4, 64, 64, 4, 2
+    mk = lambda pipeline: TransformerEncoder(
+        encoder_layers=LAYERS, embed_dim=E, ffn_embed_dim=128,
+        attention_heads=H, max_seq_len=L, use_ring=True,
+        emb_dropout=0.0, dropout=0.0, attention_dropout=0.0,
+        post_ln=True,
+        pipeline_stages=2 if pipeline else 0, pipeline_microbatches=2,
+    )
+    enc_pipe, enc_plain = mk(True), mk(False)
+    emb = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    pm = jnp.asarray(
+        (np.arange(L)[None, :] >= np.array([50, 64, 40, 64])[:, None])
+        .astype(np.float32)
+    )
+    p_pipe = enc_pipe.init(
+        {"params": jax.random.PRNGKey(1)}, emb, None, pm
+    )["params"]
+    p_plain = dict(enc_plain.init(
+        {"params": jax.random.PRNGKey(2)}, emb, None, pm
+    )["params"])
+    stack = p_pipe["pipeline_stack"]
+    for i in range(LAYERS):
+        p_plain[f"layers_{i}"] = jax.tree_util.tree_map(
+            lambda s, i=i: s[i], stack
+        )
+    for shared in ("emb_layer_norm", "relative_attention_bias"):
+        if shared in p_pipe:
+            p_plain[shared] = p_pipe[shared]
+
+    o_pipe = jax.jit(
+        lambda p, e: enc_pipe.apply({"params": p}, e, padding_mask=pm)
+    )(p_pipe, emb)
+    o_plain = jax.jit(
+        lambda p, e: enc_plain.apply({"params": p}, e, padding_mask=pm)
+    )(p_plain, emb)
+    err = float(jnp.abs(o_pipe - o_plain).max())
+    assert err < 1e-4, err
+
+    # Gradients: the two programs schedule the SAME ring math differently
+    # (scan-over-layers + pipe psum vs per-layer shard_maps), so fp32
+    # reduction-order noise (~1e-6/element, the forward's level) reaches
+    # early-layer grads through the later layers' ring backward and gets
+    # amplified by cancellation in token-summed projection-bias grads
+    # (measured ~5e-4 on this config; layer-1 leaves, whose cotangents
+    # never cross a ring backward, agree to ~1e-6).  Hence the 1e-3 bound.
+    g_pipe = jax.jit(jax.grad(
+        lambda p: jnp.sum(enc_pipe.apply({"params": p}, emb,
+                                         padding_mask=pm) ** 2)
+    ))(p_pipe)
+    g_plain = jax.jit(jax.grad(
+        lambda p: jnp.sum(enc_plain.apply({"params": p}, emb,
+                                          padding_mask=pm) ** 2)
+    ))(p_plain)
+    g_plain_stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[g_plain[f"layers_{i}"] for i in range(LAYERS)],
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_pipe["pipeline_stack"]),
+        jax.tree_util.tree_leaves(g_plain_stacked),
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-3
+    a = g_pipe["relative_attention_bias"]["embedding"]
+    b = g_plain["relative_attention_bias"]["embedding"]
+    scale = max(1.0, float(jnp.abs(b).max()))
+    assert float(jnp.abs(a - b).max()) / scale < 1e-3
+    # the last stage's leaves see no ring backward between them and the
+    # loss: they must agree at fp32-noise level, pinning that the looser
+    # bound above only covers accumulation-order noise, not a math bug
+    last = jax.tree_util.tree_map(
+        lambda s: s[-1], g_pipe["pipeline_stack"]
+    )
+    last_plain = g_plain[f"layers_{LAYERS - 1}"]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(last),
+        jax.tree_util.tree_leaves(last_plain),
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 5e-5
+
+
+def test_unimol_pair_encoder_row_sharded_seq():
+    """Uni-Mol-family SP (round-4 verdict #3): seq_shard=True row-shards
+    the evolving (B, H, L, L) pair stream over the 'seq' axis via GSPMD
+    constraints.  Sharding constraints are semantics-preserving, so the
+    outputs must match the unsharded run; the win is distribution of the
+    dominant activation, which the dryrun leg exercises."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.modules.transformer_encoder_with_pair import (
+        TransformerEncoderWithPair,
+    )
+
+    mesh = make_mesh(data=2, seq=4)
+    set_global_mesh(mesh)
+    B, L, D, H = 2, 32, 64, 8  # L % seq == 0
+    mk = lambda shard: TransformerEncoderWithPair(
+        encoder_layers=2, embed_dim=D, ffn_embed_dim=128,
+        attention_heads=H, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0, activation_dropout=0.0, max_seq_len=L,
+        seq_shard=shard,
+    )
+    enc_s, enc_r = mk(True), mk(False)
+    r = np.random.RandomState(0)
+    emb = jnp.asarray(r.randn(B, L, D), jnp.float32)
+    bias = jnp.asarray(r.randn(B, H, L, L), jnp.float32)
+    pm = jnp.asarray(
+        (np.arange(L)[None, :] >= np.array([25, 32])[:, None])
+        .astype(np.float32)
+    )
+    params = enc_s.init({"params": jax.random.PRNGKey(0)}, emb, bias, pm)
+
+    run_s = jax.jit(lambda p: enc_s.apply(p, emb, bias, pm))
+    run_r = jax.jit(lambda p: enc_r.apply(p, emb, bias, pm))
+    outs_s, outs_r = run_s(params), run_r(params)
+    names = ("x", "pair_rep", "delta", "x_norm", "delta_norm")
+    for name, a, b in zip(names, outs_s, outs_r):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-5, name
+
+    # gradients flow through the constrained program and match
+    def loss(enc):
+        def f(p):
+            x, pr, d, xn, dn = enc.apply(p, emb, bias, pm)
+            return jnp.sum(x ** 2) + jnp.sum(d ** 2) + xn + dn
+        return f
+
+    g_s = jax.jit(jax.grad(loss(enc_s)))(params)
+    g_r = jax.jit(jax.grad(loss(enc_r)))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_s), jax.tree_util.tree_leaves(g_r)
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-5
+
+
+def test_trainer_accepts_seq_shard_model():
+    """The Trainer's seq-axis refusal must NOT fire for a model that opts
+    into GSPMD pair-stream sharding (seq_shard) without use_ring — a REAL
+    Trainer construction, so regressing the gate clause fails here."""
+    from argparse import Namespace
+
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.unimol import UniMolModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    class _T(UnicoreTask):
+        class _D:
+            def pad(self):
+                return 0
+
+        dictionary = _D()
+
+    args = Namespace(
+        seed=1, bf16=False, fp16=False, bf16_sr=False,
+        allreduce_fp32_grad=False, fp16_init_scale=4, fp16_scale_window=None,
+        min_loss_scale=1e-4, clip_norm=0.0, per_sample_clip_norm=0.0,
+        data_parallel_size=-1, model_parallel_size=1, seq_parallel_size=4,
+        pipeline_parallel_size=1, expert_parallel_size=1,
+        zero_shard_optimizer=False, optimizer="adam", lr_scheduler="fixed",
+        lr=[1e-3], adam_betas="(0.9, 0.999)", adam_eps=1e-8,
+        weight_decay=0.0, force_anneal=None, lr_shrink=0.1,
+        warmup_updates=0, ema_decay=-1.0, validate_with_ema=False,
+        max_update=10, update_freq=[1], donate_train_state=False,
+        no_weight_decay_names="",
+        masked_token_loss=1.0, masked_coord_loss=1.0, masked_dist_loss=1.0,
+        x_norm_loss=0.01, delta_pair_repr_norm_loss=0.01,
+    )
+    model = UniMolModel(
+        vocab_size=16, padding_idx=0, encoder_layers=1,
+        encoder_embed_dim=32, encoder_ffn_embed_dim=64,
+        encoder_attention_heads=4, max_seq_len=16, gaussian_kernels=8,
+        seq_shard=True,
+    )
+    # must construct without the seq-axis ValueError
+    Trainer(args, _T(args), model, LOSS_REGISTRY["unimol"](_T(args)))
+
+
+def test_unimol_refuses_seq_plus_pipeline():
+    """--seq-parallel-size with --pipeline-parallel-size on unimol would
+    silently replicate over seq; build_model must refuse up front."""
+    from argparse import Namespace
+
+    from unicore_tpu.models.unimol import UniMolModel
+
+    class _T:
+        class _D:
+            def pad(self):
+                return 0
+
+            def __len__(self):
+                return 16
+
+        dictionary = _D()
+
+    args = Namespace(
+        seq_parallel_size=2, pipeline_parallel_size=2, arch="unimol_tiny",
+    )
+    with pytest.raises(ValueError, match="does not compose"):
+        UniMolModel.build_model(args, _T())

@@ -139,6 +139,11 @@ class UniMolModel(BaseUnicoreModel):
     # GPipe over the mesh 'pipe' axis; set from --pipeline-parallel-size
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
+    # sequence parallelism (--seq-parallel-size): the pair-evolving stack
+    # row-shards its (B, H, L, L) pair stream over the mesh 'seq' axis via
+    # GSPMD constraints (TransformerEncoderWithPair.seq_shard) — the
+    # ring/ulysses paths can't serve return_attn attention
+    seq_shard: bool = False
 
     supports_masked_gather = False  # heads need full-sequence features
 
@@ -167,6 +172,19 @@ class UniMolModel(BaseUnicoreModel):
     @classmethod
     def build_model(cls, args, task):
         unimol_base_architecture(args)
+        if (
+            getattr(args, "seq_parallel_size", 1) > 1
+            and getattr(args, "pipeline_parallel_size", 1) > 1
+        ):
+            # statically known at build time: the pair-stream row sharding
+            # does not compose with the GPipe microbatch layout yet, and
+            # silently replicating over seq is exactly what the Trainer's
+            # seq-axis gate exists to refuse
+            raise ValueError(
+                "unimol: --seq-parallel-size > 1 does not compose with "
+                "--pipeline-parallel-size > 1 (the row-sharded pair stream "
+                "can't ride the uniform GPipe microbatch spec); drop one"
+            )
         return cls(
             vocab_size=len(task.dictionary),
             padding_idx=task.dictionary.pad(),
@@ -192,6 +210,7 @@ class UniMolModel(BaseUnicoreModel):
             pipeline_microbatches=getattr(
                 args, "pipeline_microbatches", 4
             ) or 4,
+            seq_shard=getattr(args, "seq_parallel_size", 1) > 1,
         )
 
     def setup(self):
@@ -221,6 +240,7 @@ class UniMolModel(BaseUnicoreModel):
             post_ln=self.post_ln,
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
+            seq_shard=self.seq_shard,
             name="encoder",
         )
         if self.masked_token_loss > 0:

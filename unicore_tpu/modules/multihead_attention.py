@@ -351,6 +351,13 @@ class SelfMultiheadAttention(nn.Module):
     use_flash: bool = True
     use_ring: bool = False  # seq parallelism over the mesh 'seq' axis
     seq_impl: str = "ring"  # 'ring' (ppermute) or 'ulysses' (all-to-all)
+    # ALREADY inside a shard_map whose 'seq' axis shards the sequence dim
+    # (the pipelined encoder's stage body): inputs are per-device chunks,
+    # so run the ring collectives directly instead of wrapping a (then
+    # illegally nested) shard_map.  attn_bias must arrive pre-sliced to
+    # this rank's query rows (H|1, Lc, L); key_padding_mask is the local
+    # key chunk (B, Lc).
+    seq_inside: bool = False
 
     @nn.compact
     def __call__(
@@ -380,12 +387,18 @@ class SelfMultiheadAttention(nn.Module):
         k = _split_heads(k, self.num_heads)
         v = _split_heads(v, self.num_heads)
 
-        o, attn_weights, attn_probs = _attend(
-            self, q, k, v, key_padding_mask, attn_bias,
-            self.dropout, train, return_attn, self.use_flash,
-            use_ring=self.use_ring,
-            seq_impl=self.seq_impl,
-        )
+        if self.seq_inside:
+            o = self._ring_in_shard(
+                q, k, v, key_padding_mask, attn_bias, return_attn, train
+            )
+            attn_weights = attn_probs = None
+        else:
+            o, attn_weights, attn_probs = _attend(
+                self, q, k, v, key_padding_mask, attn_bias,
+                self.dropout, train, return_attn, self.use_flash,
+                use_ring=self.use_ring,
+                seq_impl=self.seq_impl,
+            )
 
         o = _merge_heads(o)
         o = nn.Dense(
@@ -400,6 +413,42 @@ class SelfMultiheadAttention(nn.Module):
             return o
         else:
             return o, attn_weights, attn_probs
+
+    def _ring_in_shard(self, q, k, v, key_padding_mask, attn_bias,
+                       return_attn, train):
+        """Ring attention on per-device chunks, for callers already inside
+        a shard_map over the mesh 'seq' axis (the GPipe stage body —
+        dp x pp x sp composition)."""
+        from unicore_tpu.parallel.mesh import (
+            DATA_AXIS, SEQ_AXIS, get_global_mesh,
+        )
+        from unicore_tpu.parallel.ring_attention import ring_attention
+
+        assert not return_attn, (
+            "return_attn inside the seq-sharded pipeline is unsupported "
+            "(the ring never materializes the probabilities)"
+        )
+        eff_dropout = self.dropout if train else 0.0
+        rng = self.make_rng("dropout") if eff_dropout > 0.0 else None
+        mesh = get_global_mesh()
+        extra = (
+            (DATA_AXIS,)
+            if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1
+            else ()
+        )
+        kvm = None
+        if key_padding_mask is not None and key_padding_mask.ndim != 0:
+            kvm = key_padding_mask.astype(jnp.int32)
+        return ring_attention(
+            q, k, v,
+            axis_name=SEQ_AXIS,
+            kv_mask=kvm,
+            bias=attn_bias,  # pre-sliced (H|1, Lc, L) by the const spec
+            sm_scale=1.0,  # q is pre-scaled
+            dropout_rate=eff_dropout,
+            dropout_rng=rng,
+            extra_rng_axes=extra,
+        )
 
 
 class CrossMultiheadAttention(nn.Module):

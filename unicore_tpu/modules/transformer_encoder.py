@@ -89,6 +89,10 @@ class TransformerEncoderLayer(nn.Module):
     post_ln: bool = False
     use_ring: bool = False
     seq_impl: str = "ring"
+    # inside a shard_map whose 'seq' axis shards the sequence dim (the
+    # GPipe stage body): the attention runs ring collectives directly on
+    # the local chunks (see SelfMultiheadAttention.seq_inside)
+    seq_inside: bool = False
 
     @nn.compact
     def __call__(
@@ -117,6 +121,7 @@ class TransformerEncoderLayer(nn.Module):
             dropout=self.attention_dropout,
             use_ring=self.use_ring,
             seq_impl=self.seq_impl,
+            seq_inside=self.seq_inside,
             name="self_attn",
         )(
             x,
@@ -245,12 +250,13 @@ class TransformerEncoder(nn.Module):
             # stacked per-layer params for the GPipe schedule: leading dim
             # num_layers, sharded over 'pipe' by DEFAULT_PP_RULES
             assert self.moe_experts == 0, "MoE inside the pipeline: unsupported"
-            assert not self.use_ring, (
-                "sequence parallelism inside the pipeline is unsupported "
-                "(the stage template would need a nested seq shard_map); "
-                "drop --seq-parallel-size or --pipeline-parallel-size"
+            assert not (self.use_ring and self.seq_impl != "ring"), (
+                "only the ring seq-parallel impl composes with the "
+                "pipeline (its collectives run directly inside the stage "
+                "shard_map); use --seq-parallel-impl ring or drop "
+                "--pipeline-parallel-size"
             )
-            template = TransformerEncoderLayer(
+            self._pipe_template_kwargs = dict(
                 embed_dim=self.embed_dim,
                 ffn_embed_dim=self.ffn_embed_dim,
                 attention_heads=self.attention_heads,
@@ -260,7 +266,14 @@ class TransformerEncoder(nn.Module):
                 activation_fn=self.activation_fn,
                 post_ln=self.post_ln,
             )
+            template = TransformerEncoderLayer(**self._pipe_template_kwargs)
             self._pipe_template = template
+            # variant for stage bodies whose 'seq' mesh axis shards the
+            # sequence dim (dp x pp x sp); same params, different routing —
+            # flax requires module construction here, not at call time
+            self._pipe_template_seq = TransformerEncoderLayer(
+                **self._pipe_template_kwargs, seq_inside=True
+            )
 
             def stack_init(rng):
                 dummy = jnp.zeros((1, 8, self.embed_dim), jnp.float32)
@@ -349,14 +362,46 @@ class TransformerEncoder(nn.Module):
         return x
 
     def _pipeline_forward(self, x, attn_bias, padding_mask, train):
-        """GPipe schedule over the mesh 'pipe' axis (parallel/pipeline.py)."""
+        """GPipe schedule over the mesh 'pipe' axis (parallel/pipeline.py).
+
+        Composes with ring sequence parallelism (dp x pp x sp): when the
+        mesh carries a live 'seq' axis dividing L, the microbatch sequence
+        dim shards over it, the stationary bias shards by query rows, and
+        the stage body's attention runs the ring collectives directly
+        inside the pipe shard_map (TransformerEncoderLayer.seq_inside)."""
+        from jax.sharding import PartitionSpec as P
+
+        from unicore_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
         from unicore_tpu.parallel.pipeline import gpipe, plan_schedule
 
         B, L, D = x.shape
         mesh, n_micro, mb, batched = plan_schedule(
             self.pipeline_stages, B, self.pipeline_microbatches
         )
-        template = self._pipe_template
+        n_seq = mesh.shape.get(SEQ_AXIS, 1)
+        seq_on = self.use_ring and n_seq > 1 and L % n_seq == 0
+        if self.use_ring and n_seq > 1 and not seq_on:
+            import logging
+
+            from unicore_tpu.parallel.mesh import warn_once
+
+            warn_once(
+                logging.getLogger(__name__),
+                f"pipelined encoder: seq axis {n_seq} does not divide "
+                f"L={L}; running replicated over the seq axis",
+            )
+        if seq_on:
+            template = self._pipe_template_seq
+            data_ax = batched[1] if len(batched) > 1 else None
+            mb_spec = P(None, data_ax, SEQ_AXIS)
+            const_specs = (
+                None if attn_bias is None
+                else {"bias": P(None, SEQ_AXIS, None)}  # query rows
+            )
+        else:
+            template = self._pipe_template
+            mb_spec = batched
+            const_specs = None
 
         if padding_mask is None:
             padding_mask = jnp.zeros((B, L), jnp.int32)
@@ -370,11 +415,23 @@ class TransformerEncoder(nn.Module):
             or self.activation_dropout > 0
         )
         rng = self.make_rng("dropout") if has_dropout else None
+        data_live = mesh.shape.get(DATA_AXIS, 1) > 1
 
         def stage_apply(p_stack, tree, step_rng):
             mb_tree, consts_ = tree
             h, pm = mb_tree["x"], mb_tree["pm"]
             bias = consts_.get("bias") if consts_ else None
+            if step_rng is not None:
+                # decorrelate dropout masks across the sharded axes: each
+                # seq/data rank holds a DIFFERENT slice of the activations
+                if seq_on:
+                    step_rng = jax.random.fold_in(
+                        step_rng, jax.lax.axis_index(SEQ_AXIS)
+                    )
+                if data_live:
+                    step_rng = jax.random.fold_in(
+                        step_rng, jax.lax.axis_index(DATA_AXIS)
+                    )
 
             def body(carry, xs):
                 p_layer, li = xs
@@ -400,6 +457,7 @@ class TransformerEncoder(nn.Module):
             mbs,
             consts,
             rng=rng,
-            mb_spec=batched,
+            mb_spec=mb_spec,
+            const_specs=const_specs,
         )
         return outs["x"].reshape(B, L, D)

@@ -40,6 +40,16 @@ class TransformerEncoderWithPair(nn.Module):
     # encoder_layers % stages == 0, batch % pipeline_microbatches == 0.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
+    # Sequence parallelism for the pair-evolving stack (--seq-parallel-size
+    # on the unimol family).  The ring/ulysses paths can't serve this
+    # attention — its probabilities ARE a model output — so instead the
+    # whole pair stream is ROW-SHARDED over the mesh 'seq' axis via GSPMD
+    # sharding constraints: each device keeps (B, H, L/P, L) rows of the
+    # evolving pair representation (and the matching L/P activation rows),
+    # XLA inserts the k/v all-gathers the row-local attention needs.  The
+    # dominant (B, H, L, L) activation — the reason SP is wanted here —
+    # then never materializes whole on one device.
+    seq_shard: bool = False
 
     def setup(self):
         self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
@@ -106,11 +116,28 @@ class TransformerEncoderWithPair(nn.Module):
         input_attn_mask = attn_mask
         pair_bias = attn_mask  # (B, H, L, L) or None
         attn_weights = None
+        shard_rows = self._row_shard_constrainer(seq_len)
         if self.pipeline_stages > 1:
+            if self.seq_shard:
+                import logging
+
+                from unicore_tpu.parallel.mesh import warn_once
+
+                # UniMolModel.build_model refuses this combination up
+                # front; direct module users get the one-shot warning
+                warn_once(
+                    logging.getLogger(__name__),
+                    "pair-encoder seq sharding does not compose with the "
+                    "pipeline yet (the GPipe microbatch spec is uniform "
+                    "across leaves); running replicated over the seq axis",
+                )
             x, attn_weights = self._pipeline_forward(
                 x, pair_bias, padding_mask, train
             )
         else:
+            x = shard_rows(x, 1)
+            if pair_bias is not None and pair_bias.ndim == 4:
+                pair_bias = shard_rows(pair_bias, 2)
             for layer in self.layers:
                 x, attn_weights, _ = layer(
                     x,
@@ -119,7 +146,11 @@ class TransformerEncoderWithPair(nn.Module):
                     return_attn=True,
                     train=train,
                 )
-                # pre-softmax weights become the evolved pair representation
+                # pre-softmax weights become the evolved pair representation,
+                # pinned to query-row sharding so the (B, H, L, L) stream
+                # stays distributed over the seq axis layer to layer
+                x = shard_rows(x, 1)
+                attn_weights = shard_rows(attn_weights, 2)
                 pair_bias = attn_weights
 
         if not self.post_ln:
@@ -164,6 +195,44 @@ class TransformerEncoderWithPair(nn.Module):
             delta = d.transpose(0, 3, 1, 2)
 
         return x, pair_rep, delta, x_norm, delta_norm
+
+    def _row_shard_constrainer(self, seq_len):
+        """Returns ``constrain(t, row_dim)`` pinning dim ``row_dim`` (the
+        query-row dim) to the mesh 'seq' axis and the batch dim to 'data',
+        or an identity when sequence sharding can't engage (no live seq
+        axis, indivisible L, or seq_shard off)."""
+        from unicore_tpu.parallel.mesh import (
+            DATA_AXIS, SEQ_AXIS, get_global_mesh,
+        )
+
+        mesh = get_global_mesh()
+        n_seq = 1 if mesh is None else mesh.shape.get(SEQ_AXIS, 1)
+        if not (self.seq_shard and n_seq > 1 and seq_len % n_seq == 0):
+            if self.seq_shard and n_seq > 1:
+                import logging
+
+                from unicore_tpu.parallel.mesh import warn_once
+
+                warn_once(
+                    logging.getLogger(__name__),
+                    f"pair-encoder seq sharding: seq axis {n_seq} does not "
+                    f"divide L={seq_len}; running replicated over seq",
+                )
+            return lambda t, row_dim: t
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_ax = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+
+        def constrain(t, row_dim):
+            spec = [None] * t.ndim
+            spec[0] = data_ax
+            spec[row_dim] = SEQ_AXIS
+            return jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, P(*spec))
+            )
+
+        return constrain
 
     def _pipeline_forward(self, x, pair_bias, padding_mask, train):
         """GPipe schedule for the pair-evolving stack: each microbatch tree

@@ -81,6 +81,19 @@ def get_global_mesh() -> Optional[Mesh]:
     return _global_mesh
 
 
+_warned_once = set()
+
+
+def warn_once(logger_, msg: str):
+    """Log ``msg`` at WARNING level once per process (module bodies retrace
+    per distinct shape — without this, every retrace re-emits the same
+    fallback warning; mirrors modules._warn_flash_fallback)."""
+    if msg in _warned_once:
+        return
+    _warned_once.add(msg)
+    logger_.warning(msg)
+
+
 def batch_spec() -> P:
     """Batch arrays: sharded over (data, seq if used) on the leading dims."""
     return P((DATA_AXIS,))

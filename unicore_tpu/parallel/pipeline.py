@@ -70,6 +70,7 @@ def gpipe(
     rng: Optional[jax.Array] = None,
     pipe_axis: str = PIPE_AXIS,
     mb_spec: P = P(),
+    const_specs=None,
 ):
     """Run ``stage_apply`` as a GPipe pipeline.
 
@@ -89,6 +90,10 @@ def gpipe(
             ``P(None, 'data')`` keeps the batch dim sharded over the data
             axis so the pipeline composes with data parallelism instead of
             all-gathering the batch.
+        const_specs: optional pytree of PartitionSpecs matching
+            ``constants`` (default: all replicated) — e.g. the stationary
+            rel-pos bias sharded by query rows over 'seq' when the stage
+            body runs ring attention (dp x pp x sp composition).
 
     Returns the pipeline output microbatches, same structure/shape as
     ``microbatches``, replicated over the pipe axis.
@@ -161,7 +166,11 @@ def gpipe(
     in_specs = [
         pspec,
         jax.tree_util.tree_map(lambda _: mb_spec, microbatches),
-        jax.tree_util.tree_map(lambda _: P(), constants),
+        (
+            const_specs
+            if const_specs is not None
+            else jax.tree_util.tree_map(lambda _: P(), constants)
+        ),
     ]
     operands = [stacked_params, microbatches, constants]
     if has_rng:

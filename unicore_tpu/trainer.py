@@ -94,18 +94,20 @@ class Trainer(object):
         set_global_mesh(self.mesh)
         from unicore_tpu.parallel import SEQ_AXIS
 
-        if self.mesh.shape.get(SEQ_AXIS, 1) > 1 and not getattr(
-            model, "use_ring", False
+        if self.mesh.shape.get(SEQ_AXIS, 1) > 1 and not (
+            getattr(model, "use_ring", False)
+            or getattr(model, "seq_shard", False)
         ):
             # a seq axis would silently do replicated work: fail loudly
             # instead of burning 1/seq of the machine
             raise ValueError(
                 f"--seq-parallel-size {self.mesh.shape[SEQ_AXIS]} requested "
                 f"but model {type(model).__name__} does not enable sequence "
-                "parallelism (no use_ring support — e.g. pair-evolving and "
-                "Evoformer attention need full rows / return_attn).  Remove "
+                "parallelism (neither the ring/ulysses paths via use_ring "
+                "nor GSPMD pair-stream row sharding via seq_shard).  Remove "
                 "--seq-parallel-size or use a model family that supports it "
-                "(bert)."
+                "(bert: ring/ulysses, also inside the pipeline; unimol: "
+                "row-sharded pair stream)."
             )
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
