@@ -37,6 +37,10 @@ class EvoformerModel(BaseUnicoreModel):
     # pipeline candidate); set from --pipeline-parallel-size
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
+    # sequence parallelism (--seq-parallel-size): msa/pair streams
+    # row-sharded over the mesh 'seq' axis via GSPMD constraints
+    # (EvoformerStack.seq_shard)
+    seq_shard: bool = False
 
     @classmethod
     def add_args(cls, parser):
@@ -55,6 +59,15 @@ class EvoformerModel(BaseUnicoreModel):
     @classmethod
     def build_model(cls, args, task):
         evoformer_base_architecture(args)
+        if (
+            getattr(args, "seq_parallel_size", 1) > 1
+            and getattr(args, "pipeline_parallel_size", 1) > 1
+        ):
+            raise ValueError(
+                "evoformer: --seq-parallel-size > 1 does not compose with "
+                "--pipeline-parallel-size > 1 (the row-sharded streams "
+                "can't ride the uniform GPipe microbatch spec); drop one"
+            )
         return cls(
             vocab_size=len(task.dictionary),
             padding_idx=task.dictionary.pad(),
@@ -73,6 +86,7 @@ class EvoformerModel(BaseUnicoreModel):
             pipeline_microbatches=getattr(
                 args, "pipeline_microbatches", 4
             ) or 4,
+            seq_shard=getattr(args, "seq_parallel_size", 1) > 1,
         )
 
     def setup(self):
@@ -109,6 +123,7 @@ class EvoformerModel(BaseUnicoreModel):
             remat=self.remat,
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
+            seq_shard=self.seq_shard,
             name="evoformer",
         )
         self.masked_msa_head = nn.Dense(

@@ -52,6 +52,10 @@ class GatedAttention(nn.Module):
     embed_dim: int
     num_heads: int
     gating: bool = True
+    # False forces the XLA softmax path: under GSPMD row sharding
+    # (EvoformerStack.seq_shard) a pallas_call can't be auto-partitioned,
+    # so the sharded stack runs the partitionable XLA path instead
+    use_flash: bool = True
 
     @nn.compact
     def __call__(
@@ -92,7 +96,7 @@ class GatedAttention(nn.Module):
         N = 1
         for d in lead:
             N *= d
-        if _flash_ok(N, Lq, Lk, head_dim, q.dtype, bias):
+        if self.use_flash and _flash_ok(N, Lq, Lk, head_dim, q.dtype, bias):
             from unicore_tpu.ops.flash_attention import flash_attention
 
             kvm = None
@@ -178,6 +182,7 @@ class MSARowAttentionWithPairBias(nn.Module):
     embed_dim: int
     pair_dim: int
     num_heads: int
+    use_flash: bool = True
 
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None):
@@ -193,9 +198,10 @@ class MSARowAttentionWithPairBias(nn.Module):
         # mask rides separately so the kernel path never materializes the
         # per-row (B, R, H, L, L) combined bias the old layout implied
         bias = pair_bias.transpose(0, 3, 1, 2)  # (B, H, L, L)
-        out = GatedAttention(self.embed_dim, self.num_heads, name="attn")(
-            m, m, bias=bias, kv_mask=msa_mask
-        )
+        out = GatedAttention(
+            self.embed_dim, self.num_heads, use_flash=self.use_flash,
+            name="attn",
+        )(m, m, bias=bias, kv_mask=msa_mask)
         return out
 
 
@@ -204,15 +210,17 @@ class MSAColumnAttention(nn.Module):
 
     embed_dim: int
     num_heads: int
+    use_flash: bool = True
 
     @nn.compact
     def __call__(self, msa, msa_mask=None):
         m = LayerNorm(self.embed_dim, name="ln_m")(msa)
         mt = m.swapaxes(1, 2)  # (B, L, R, D)
         col_mask = msa_mask.swapaxes(1, 2) if msa_mask is not None else None
-        out = GatedAttention(self.embed_dim, self.num_heads, name="attn")(
-            mt, mt, kv_mask=col_mask
-        )
+        out = GatedAttention(
+            self.embed_dim, self.num_heads, use_flash=self.use_flash,
+            name="attn",
+        )(mt, mt, kv_mask=col_mask)
         return out.swapaxes(1, 2)
 
 
@@ -305,6 +313,7 @@ class TriangleAttention(nn.Module):
     pair_dim: int
     num_heads: int
     starting: bool = True
+    use_flash: bool = True
 
     @nn.compact
     def __call__(self, pair, pair_mask=None):
@@ -320,9 +329,10 @@ class TriangleAttention(nn.Module):
         pm = None
         if pair_mask is not None:
             pm = pair_mask if self.starting else pair_mask.swapaxes(1, 2)
-        out = GatedAttention(self.pair_dim, self.num_heads, name="attn")(
-            z, z, bias=bias, kv_mask=pm
-        )
+        out = GatedAttention(
+            self.pair_dim, self.num_heads, use_flash=self.use_flash,
+            name="attn",
+        )(z, z, bias=bias, kv_mask=pm)
         return out if self.starting else out.swapaxes(1, 2)
 
 
@@ -349,6 +359,7 @@ class EvoformerIteration(nn.Module):
     msa_heads: int = 8
     pair_heads: int = 4
     dropout: float = 0.1
+    use_flash: bool = True
 
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None, pair_mask=None, train=False):
@@ -357,12 +368,14 @@ class EvoformerIteration(nn.Module):
 
         msa = msa + drop_row(
             MSARowAttentionWithPairBias(
-                self.msa_dim, self.pair_dim, self.msa_heads, name="msa_row_attn"
+                self.msa_dim, self.pair_dim, self.msa_heads,
+                use_flash=self.use_flash, name="msa_row_attn",
             )(msa, pair, msa_mask),
             deterministic=det,
         )
         msa = msa + MSAColumnAttention(
-            self.msa_dim, self.msa_heads, name="msa_col_attn"
+            self.msa_dim, self.msa_heads, use_flash=self.use_flash,
+            name="msa_col_attn",
         )(msa, msa_mask)
         msa = msa + Transition(self.msa_dim, name="msa_transition")(msa)
 
@@ -383,13 +396,15 @@ class EvoformerIteration(nn.Module):
         )
         pair = pair + drop_row(
             TriangleAttention(
-                self.pair_dim, self.pair_heads, starting=True, name="tri_attn_start"
+                self.pair_dim, self.pair_heads, starting=True,
+                use_flash=self.use_flash, name="tri_attn_start",
             )(pair, pair_mask),
             deterministic=det,
         )
         pair = pair + drop_row(
             TriangleAttention(
-                self.pair_dim, self.pair_heads, starting=False, name="tri_attn_end"
+                self.pair_dim, self.pair_heads, starting=False,
+                use_flash=self.use_flash, name="tri_attn_end",
             )(pair, pair_mask),
             deterministic=det,
         )
@@ -412,19 +427,45 @@ class EvoformerStack(nn.Module):
     # pipeline_microbatches == 0.  0 = off.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
+    # Sequence parallelism for the deep pair stack: both evolving streams
+    # row-shard over the mesh 'seq' axis via GSPMD constraints — msa
+    # (B, R, L, D) on its residue dim, pair (B, I, J, D) on its lead-row
+    # dim — so the O(L^2) pair activations distribute across devices and
+    # XLA inserts the gathers row-local attention needs.  The Pallas
+    # kernel route is disabled under sharding (a pallas_call can't be
+    # auto-partitioned); the partitionable XLA path runs instead.
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None, pair_mask=None, train=False):
         if self.pipeline_stages > 1:
+            if self.seq_shard:
+                import logging
+
+                from unicore_tpu.parallel.mesh import warn_once
+
+                # EvoformerModel.build_model refuses this combination up
+                # front; direct module users get the one-shot warning
+                warn_once(
+                    logging.getLogger(__name__),
+                    "evoformer seq sharding does not compose with the "
+                    "pipeline yet; running replicated over the seq axis",
+                )
             return self._pipeline_forward(
                 msa, pair, msa_mask, pair_mask, train
             )
+        from unicore_tpu.parallel.sharding import seq_row_constrainer
+
+        L = msa.shape[2]
+        shard_rows = seq_row_constrainer(L, self.seq_shard, "evoformer")
+        seq_on = shard_rows.engaged
         block_cls = EvoformerIteration
         if self.remat:
             # trade FLOPs for activation memory across the deep stack
             block_cls = nn.remat(
                 EvoformerIteration, static_argnums=(5,)
             )
+        msa, pair = shard_rows(msa, 2), shard_rows(pair, 1)
         for i in range(self.num_blocks):
             msa, pair = block_cls(
                 msa_dim=self.msa_dim,
@@ -432,8 +473,12 @@ class EvoformerStack(nn.Module):
                 msa_heads=self.msa_heads,
                 pair_heads=self.pair_heads,
                 dropout=self.dropout,
+                use_flash=not seq_on,
                 name=f"block_{i}",
             )(msa, pair, msa_mask, pair_mask, train)
+            # re-pin both streams each block so the layout survives the
+            # transposing ops (column attention, triangle 'ending' swap)
+            msa, pair = shard_rows(msa, 2), shard_rows(pair, 1)
         return msa, pair
 
     def _pipeline_forward(self, msa, pair, msa_mask, pair_mask, train):

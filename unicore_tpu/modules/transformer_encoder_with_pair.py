@@ -197,42 +197,12 @@ class TransformerEncoderWithPair(nn.Module):
         return x, pair_rep, delta, x_norm, delta_norm
 
     def _row_shard_constrainer(self, seq_len):
-        """Returns ``constrain(t, row_dim)`` pinning dim ``row_dim`` (the
-        query-row dim) to the mesh 'seq' axis and the batch dim to 'data',
-        or an identity when sequence sharding can't engage (no live seq
-        axis, indivisible L, or seq_shard off)."""
-        from unicore_tpu.parallel.mesh import (
-            DATA_AXIS, SEQ_AXIS, get_global_mesh,
-        )
+        """``constrain(t, row_dim)`` pinning query rows to the mesh 'seq'
+        axis (identity when sharding can't engage) — shared helper in
+        parallel/sharding.py."""
+        from unicore_tpu.parallel.sharding import seq_row_constrainer
 
-        mesh = get_global_mesh()
-        n_seq = 1 if mesh is None else mesh.shape.get(SEQ_AXIS, 1)
-        if not (self.seq_shard and n_seq > 1 and seq_len % n_seq == 0):
-            if self.seq_shard and n_seq > 1:
-                import logging
-
-                from unicore_tpu.parallel.mesh import warn_once
-
-                warn_once(
-                    logging.getLogger(__name__),
-                    f"pair-encoder seq sharding: seq axis {n_seq} does not "
-                    f"divide L={seq_len}; running replicated over seq",
-                )
-            return lambda t, row_dim: t
-
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        data_ax = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
-
-        def constrain(t, row_dim):
-            spec = [None] * t.ndim
-            spec[0] = data_ax
-            spec[row_dim] = SEQ_AXIS
-            return jax.lax.with_sharding_constraint(
-                t, NamedSharding(mesh, P(*spec))
-            )
-
-        return constrain
+        return seq_row_constrainer(seq_len, self.seq_shard, "pair-encoder")
 
     def _pipeline_forward(self, x, pair_bias, padding_mask, train):
         """GPipe schedule for the pair-evolving stack: each microbatch tree

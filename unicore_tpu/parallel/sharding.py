@@ -146,3 +146,48 @@ def named(mesh: Mesh, pspecs):
         pspecs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def seq_row_constrainer(seq_len: int, enabled: bool, what: str = "stream"):
+    """GSPMD row-sharding helper for models whose attention outputs are
+    themselves model outputs (Uni-Mol pair stream, Evoformer msa/pair
+    streams) — the ring/ulysses paths can't serve those, so the stream is
+    pinned row-sharded over the mesh 'seq' axis and XLA inserts the
+    gathers the row-local attention needs.
+
+    Returns ``constrain(t, row_dim)``: dim ``row_dim`` -> 'seq', dim 0 ->
+    'data' (when live); an identity when sharding can't engage (disabled,
+    no live seq axis, or seq doesn't divide ``seq_len``).  The returned
+    function carries ``.engaged`` so callers that must react to the
+    decision (e.g. disabling a non-partitionable pallas_call route) read
+    it from the SAME predicate instead of re-deriving it."""
+    from .mesh import SEQ_AXIS, get_global_mesh, warn_once
+
+    mesh = get_global_mesh()
+    n_seq = 1 if mesh is None else mesh.shape.get(SEQ_AXIS, 1)
+    if not (enabled and n_seq > 1 and seq_len % n_seq == 0):
+        if enabled and n_seq > 1:
+            warn_once(
+                logging.getLogger(__name__),
+                f"{what} seq sharding: seq axis {n_seq} does not divide "
+                f"L={seq_len}; running replicated over seq",
+            )
+
+        def identity(t, row_dim):
+            return t
+
+        identity.engaged = False
+        return identity
+
+    data_ax = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+
+    def constrain(t, row_dim):
+        spec = [None] * t.ndim
+        spec[0] = data_ax
+        spec[row_dim] = SEQ_AXIS
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, P(*spec))
+        )
+
+    constrain.engaged = True
+    return constrain

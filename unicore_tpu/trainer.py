@@ -106,8 +106,8 @@ class Trainer(object):
                 "parallelism (neither the ring/ulysses paths via use_ring "
                 "nor GSPMD pair-stream row sharding via seq_shard).  Remove "
                 "--seq-parallel-size or use a model family that supports it "
-                "(bert: ring/ulysses, also inside the pipeline; unimol: "
-                "row-sharded pair stream)."
+                "(bert: ring/ulysses, also inside the pipeline; unimol and "
+                "evoformer: row-sharded pair/msa streams)."
             )
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
