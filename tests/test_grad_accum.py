@@ -79,9 +79,25 @@ def test_per_sample_clip_clips_each_sample():
         jnp.ones((), jnp.float32), jnp.ones((), jnp.float32),
     )
 
-    # manual: per-row grad, clip, sum (must match the vmapped path)
+    # manual: per-row grad, clip, sum (must match the vmapped path).
+    # jitted once and reused per row — the eager per-row autodiff this
+    # replaces dominated the test's wall time on the 1-core CI box
     rows = batch["net_input"]["src_tokens"].shape[0]
     rngs = jax.random.split(rng, rows)
+
+    def loss_fn(p, s1, rng_i):
+        loss, ss, _ = tr._loss_fn(p, s1, {"dropout": rng_i}, True)
+        return loss.astype(jnp.float32), ss
+
+    def row_step(p, s1, rng_i):
+        (loss, ss), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, s1, rng_i
+        )
+        g = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
+        g, gn = U.clip_grad_norm(g, args.per_sample_clip_norm)
+        return ss, g, gn
+
+    row_step_j = jax.jit(row_step)
     acc = None
     ss_acc = 0.0
     for i in range(rows):
@@ -91,12 +107,7 @@ def test_per_sample_clip_clips_each_sample():
             },
             "target": jnp.asarray(batch["target"][i:i+1]),
         }
-        def loss_fn(p):
-            loss, ss, _ = tr._loss_fn(p, s1, {"dropout": rngs[i]}, True)
-            return loss.astype(jnp.float32), ss
-        (loss, ss), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        g = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g)
-        g, gn = U.clip_grad_norm(g, args.per_sample_clip_norm)
+        ss, g, gn = row_step_j(params, s1, rngs[i])
         assert float(gn) > args.per_sample_clip_norm  # clipping is active
         acc = g if acc is None else jax.tree_util.tree_map(jnp.add, acc, g)
         ss_acc += float(ss)

@@ -440,17 +440,11 @@ class EvoformerStack(nn.Module):
     def __call__(self, msa, pair, msa_mask=None, pair_mask=None, train=False):
         if self.pipeline_stages > 1:
             if self.seq_shard:
-                import logging
-
-                from unicore_tpu.parallel.mesh import warn_once
-
-                # EvoformerModel.build_model refuses this combination up
-                # front; direct module users get the one-shot warning
-                warn_once(
-                    logging.getLogger(__name__),
-                    "evoformer seq sharding does not compose with the "
-                    "pipeline yet; running replicated over the seq axis",
+                from unicore_tpu.parallel.sharding import (
+                    warn_seq_pipeline_no_compose,
                 )
+
+                warn_seq_pipeline_no_compose("evoformer")
             return self._pipeline_forward(
                 msa, pair, msa_mask, pair_mask, train
             )

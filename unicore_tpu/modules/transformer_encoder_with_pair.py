@@ -119,18 +119,11 @@ class TransformerEncoderWithPair(nn.Module):
         shard_rows = self._row_shard_constrainer(seq_len)
         if self.pipeline_stages > 1:
             if self.seq_shard:
-                import logging
-
-                from unicore_tpu.parallel.mesh import warn_once
-
-                # UniMolModel.build_model refuses this combination up
-                # front; direct module users get the one-shot warning
-                warn_once(
-                    logging.getLogger(__name__),
-                    "pair-encoder seq sharding does not compose with the "
-                    "pipeline yet (the GPipe microbatch spec is uniform "
-                    "across leaves); running replicated over the seq axis",
+                from unicore_tpu.parallel.sharding import (
+                    warn_seq_pipeline_no_compose,
                 )
+
+                warn_seq_pipeline_no_compose("pair-encoder")
             x, attn_weights = self._pipeline_forward(
                 x, pair_bias, padding_mask, train
             )

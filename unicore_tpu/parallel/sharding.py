@@ -191,3 +191,21 @@ def seq_row_constrainer(seq_len: int, enabled: bool, what: str = "stream"):
 
     constrain.engaged = True
     return constrain
+
+
+def warn_seq_pipeline_no_compose(what: str):
+    """One-shot warning for attention-as-output stacks asked to row-shard
+    inside the pipeline: the GPipe microbatch spec is uniform across
+    leaves, so the row-sharded stream can't ride it — the stack runs
+    replicated over the seq axis instead.  Model builders refuse the
+    combination up front; this covers direct module users."""
+    import logging
+
+    from .mesh import warn_once
+
+    warn_once(
+        logging.getLogger(__name__),
+        f"{what} seq sharding does not compose with the pipeline yet "
+        "(the GPipe microbatch spec is uniform across leaves); running "
+        "replicated over the seq axis",
+    )
