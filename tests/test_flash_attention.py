@@ -178,3 +178,48 @@ def test_flash_fallback_warns_once(caplog):
         m.apply(params, x)
     warnings = [r for r in caplog.records if "flash attention unavailable" in r.message]
     assert len(warnings) == 1, [r.message for r in caplog.records]
+
+
+def test_module_flash_pads_unaligned_lengths():
+    """Round-4: lengths off the 128-tile no longer force the O(L^2)
+    fallback — the router pads (masked keys, sliced queries) when the
+    waste is small.  L=250 -> 256 through the kernel must match the fused
+    path, gradients included."""
+    from unicore_tpu.modules import SelfMultiheadAttention
+    from unicore_tpu.modules import multihead_attention as mha
+
+    B, L, E, H = 2, 250, 64, 4
+    ok, reason = mha._flash_ok(L, L, E // H, jnp.float32)
+    assert ok, reason  # the gate must accept this shape now
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, L, E))
+    bias = jax.random.normal(jax.random.PRNGKey(1), (H, L, L))
+    pm = jnp.asarray(
+        (np.arange(L)[None, :] >= np.array([200, 250])[:, None])
+        .astype(np.float32)
+    )
+    m_flash = SelfMultiheadAttention(E, H, dropout=0.0, use_flash=True)
+    m_plain = SelfMultiheadAttention(E, H, dropout=0.0, use_flash=False)
+    params = m_flash.init(
+        {"params": jax.random.PRNGKey(2)}, x, key_padding_mask=pm,
+        attn_bias=bias,
+    )
+    o1 = jax.jit(
+        lambda p: m_flash.apply(p, x, key_padding_mask=pm, attn_bias=bias)
+    )(params)
+    o2 = jax.jit(
+        lambda p: m_plain.apply(p, x, key_padding_mask=pm, attn_bias=bias)
+    )(params)
+    assert o1.shape == (B, L, E)
+    assert float(jnp.abs(o1 - o2).max()) < 5e-3
+
+    g1 = jax.jit(jax.grad(lambda p: jnp.sum(
+        m_flash.apply(p, x, key_padding_mask=pm, attn_bias=bias) ** 2
+    )))(params)
+    g2 = jax.jit(jax.grad(lambda p: jnp.sum(
+        m_plain.apply(p, x, key_padding_mask=pm, attn_bias=bias) ** 2
+    )))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 5e-3

@@ -100,18 +100,31 @@ def _bias_min_broadcast(bias, bsz, num_heads, tgt_len, src_len):
     return None
 
 
+def _flash_pad(tgt_len, src_len):
+    """Router-side padding to the kernel's 128-multiple tile sizes:
+    (pad_q, pad_k).  Padded key columns are masked out, padded query rows
+    are sliced off the output — autodiff of pad/slice keeps gradients
+    exact."""
+    return (-tgt_len) % 128, (-src_len) % 128
+
+
 def _flash_ok(tgt_len, src_len, head_dim, dtype):
-    """Shape/backend gate for the Pallas kernel: 128-aligned sequence
-    blocks on a TPU backend (or interpret mode for tests).  Returns
-    (ok, reason) so rejections are observable."""
+    """Shape/backend gate for the Pallas kernel on a TPU backend (or
+    interpret mode for tests).  Non-128-multiple lengths no longer reject —
+    the router pads (see _flash_pad) — unless padding would waste more
+    compute than the kernel saves.  Returns (ok, reason) so rejections are
+    observable."""
     from unicore_tpu.ops._pallas import interpret_enabled
 
     if not (jax.default_backend() in ("tpu", "axon") or interpret_enabled()):
         return False, f"backend {jax.default_backend()} is not a TPU"
-    if tgt_len % 128 != 0 or src_len % 128 != 0:
+    pad_q, pad_k = _flash_pad(tgt_len, src_len)
+    padded = (tgt_len + pad_q) * (src_len + pad_k)
+    if padded > 1.6 * tgt_len * src_len:
         return False, (
-            f"sequence lengths ({tgt_len}, {src_len}) are not multiples of "
-            "128 — pad to 128 (e.g. --seq-pad-multiple 128) to enable flash"
+            f"sequence lengths ({tgt_len}, {src_len}) are far from the "
+            "kernel's 128 tile (padding would waste >37% of the compute) — "
+            "pad inputs (e.g. --seq-pad-multiple 128) to enable flash"
         )
     if head_dim % 8 != 0:
         return False, f"head dim {head_dim} is not a multiple of 8"
@@ -282,33 +295,54 @@ def _attend(
                     module.make_rng("dropout"), (), 0, 2 ** 31 - 1,
                     dtype=jnp.int32,
                 )
+            # pad to the kernel's 128-multiple tiles: padded key columns
+            # mask out, padded query rows slice off (pad/slice autodiff
+            # keeps gradients exact)
+            pad_q, pad_k = _flash_pad(tgt_len, src_len)
+            kq, kk, kv_ = q, k, v
+            kmask, kbias = key_padding_mask, bias_min
+            if pad_q or pad_k:
+                kq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+                kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+                kv_ = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+                if pad_k:  # only padded KEYS need masking out
+                    if kmask is None:
+                        kmask = jnp.zeros((bsz, src_len), jnp.int32)
+                    kmask = jnp.pad(
+                        kmask.astype(jnp.int32), ((0, 0), (0, pad_k)),
+                        constant_values=1,  # nonzero = masked out
+                    )
+                if kbias is not None:
+                    kbias = jnp.pad(
+                        kbias, ((0, 0), (0, 0), (0, pad_q), (0, pad_k))
+                    )
             # moderate rows: one-shot softmax + single-pass fused backward
             from unicore_tpu.ops.attention_fullrow import (
                 fullrow_attention, supported as _fullrow_supported,
             )
 
             if _fullrow_supported(
-                tgt_len, src_len, head_dim,
-                None if bias_min is None else bias_min.shape[0],
+                tgt_len + pad_q, src_len + pad_k, head_dim,
+                None if kbias is None else kbias.shape[0],
             ):
                 o = fullrow_attention(
-                    q, k, v,
-                    bias=bias_min,
-                    kv_padding_mask=key_padding_mask,
+                    kq, kk, kv_,
+                    bias=kbias,
+                    kv_padding_mask=kmask,
                     dropout_rate=eff_dropout,
                     dropout_seed=seed,
                     sm_scale=1.0,  # q is pre-scaled
                 )
-                return o, None, None
+                return o[:, :, :tgt_len], None, None
             o = flash_attention(
-                q, k, v,
-                bias=bias_min,
-                kv_padding_mask=key_padding_mask,
+                kq, kk, kv_,
+                bias=kbias,
+                kv_padding_mask=kmask,
                 dropout_rate=eff_dropout,
                 dropout_seed=seed,
                 sm_scale=1.0,  # q is pre-scaled
             )
-            return o, None, None
+            return o[:, :, :tgt_len], None, None
 
     # fused-softmax path (materializes the attention matrix)
     attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)

@@ -38,7 +38,11 @@ NEG_INF = -1e30
 
 
 def pallas_ring_supported(Lc, head_dim, dtype):
-    """Chunk shapes the flash kernels accept (mirrors modules._flash_ok)."""
+    """Chunk shapes the flash kernels accept.  Unlike the module router's
+    _flash_ok (which since round 4 PADS non-128-multiple lengths), the
+    ring performs no padding — chunks rotate between devices, so padded
+    columns would need masking on every visit — and keeps the strict
+    Lc % 128 == 0 requirement; unaligned chunks use the jnp ring path."""
     from unicore_tpu.ops._pallas import interpret_enabled
 
     on_tpu = jax.default_backend() in ("tpu", "axon") or interpret_enabled()
