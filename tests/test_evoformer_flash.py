@@ -177,3 +177,20 @@ def test_evoformer_iteration_kernel_vs_fallback(interpret_kernels):
     for a, b in ((m_k, m_x), (z_k, z_x)):
         s = float(jnp.abs(b).max()) + 1e-6
         assert float(jnp.abs(a - b).max()) / s < 2e-4
+
+
+def test_gated_attention_pads_unaligned_length(interpret_kernels):
+    """Non-128-multiple L (e.g. an AF2-style 250 crop) rides the kernel
+    via router padding: padded keys mask out, padded query rows slice
+    off — matches the XLA fallback, gradients included."""
+    from unicore_tpu.modules.evoformer import _flash_ok
+
+    B, R, L, Dm, H = 1, 2, 250, 32, 4
+    assert _flash_ok(B * R, L, L, Dm // H, jnp.float32, None)
+    r = np.random.RandomState(5)
+    m = jnp.asarray(r.randn(B, R, L, Dm), jnp.float32)
+    bias = jnp.asarray(r.randn(B, H, L, L), jnp.float32)
+    mask = jnp.asarray(
+        (r.rand(B, R, L) > 0.2).astype(np.float32)
+    ).at[:, :, 0].set(1.0)
+    _assert_close(*_ga_both_paths(m, m, bias, mask, H))

@@ -8,9 +8,11 @@ the same computational blocks TPU-natively:
 
 - gated multi-head attention over arbitrary leading batch dims, routed
   through the Pallas flash kernel with GROUPED bias broadcast (bias slab
-  per leading group, indexed in-kernel — ops/flash_attention.py) whenever
-  shapes tile; the L x L probability matrix then never reaches HBM.  The
-  XLA softmax path remains as fallback for non-128-multiple L;
+  per leading group, indexed in-kernel — ops/flash_attention.py); the
+  L x L probability matrix then never reaches HBM.  Non-128-multiple L
+  rides the kernel via router padding (masked keys, sliced query rows);
+  the XLA softmax path remains as fallback only when padding would waste
+  more compute than the kernel saves, or under GSPMD seq sharding;
 - MSA row attention with pair bias, MSA column attention;
 - outer-product-mean MSA -> pair update;
 - triangle multiplication (outgoing/incoming) and triangle attention
@@ -103,14 +105,36 @@ class GatedAttention(nn.Module):
             if kv_mask is not None:
                 # kernel semantics: nonzero = masked OUT
                 kvm = 1 - kv_mask.reshape(N, Lk).astype(jnp.int32)
+            # pad to the kernel's 128 tiles (same scheme — and the same
+            # helper — as the module router): padded keys mask out,
+            # padded query rows slice off
+            from .multihead_attention import _flash_pad
+
+            pad_q, pad_k = _flash_pad(Lq, Lk)
+            kq = q.reshape(N, H, Lq, head_dim)
+            kk = k.reshape(N, H, Lk, head_dim)
+            kv_ = v.reshape(N, H, Lk, head_dim)
+            kbias = bias
+            if pad_q or pad_k:
+                kq = jnp.pad(kq, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+                kk = jnp.pad(kk, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+                kv_ = jnp.pad(kv_, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+                if pad_k:
+                    if kvm is None:
+                        kvm = jnp.zeros((N, Lk), jnp.int32)
+                    kvm = jnp.pad(
+                        kvm, ((0, 0), (0, pad_k)), constant_values=1
+                    )
+                if kbias is not None:
+                    kbias = jnp.pad(
+                        kbias, ((0, 0), (0, 0), (0, pad_q), (0, pad_k))
+                    )
             o = flash_attention(
-                q.reshape(N, H, Lq, head_dim),
-                k.reshape(N, H, Lk, head_dim),
-                v.reshape(N, H, Lk, head_dim),
-                bias=bias,
+                kq, kk, kv_,
+                bias=kbias,
                 kv_padding_mask=kvm,
                 sm_scale=1.0,  # q is pre-scaled
-            ).reshape(*lead, H, Lq, head_dim)
+            )[:, :, :Lq].reshape(*lead, H, Lq, head_dim)
         else:
             s = jnp.einsum("...hqd,...hkd->...hqk", q, k)
             if bias is not None:
@@ -157,18 +181,21 @@ def mask_to_bias(mask, dtype=jnp.float32):
 
 def _flash_ok(N, Lq, Lk, head_dim, dtype, bias):
     """Gate for routing GatedAttention through the Pallas flash kernel:
-    TPU (or interpret mode under test), kernel-tileable shapes, and a bias
-    whose group count divides the flattened batch.  Dropout never gates —
-    this module family applies dropout OUTSIDE attention (AF2 drop_row)."""
+    TPU (or interpret mode under test), padded-tile waste within budget
+    (the caller pads non-128-multiple lengths, masking padded keys and
+    slicing padded query rows), and a bias whose group count divides the
+    flattened batch.  Dropout never gates — this module family applies
+    dropout OUTSIDE attention (AF2 drop_row)."""
     from unicore_tpu.ops._pallas import interpret_enabled
+
+    from .multihead_attention import _flash_pad_waste_ok
 
     backend_ok = (
         jax.default_backend() in ("tpu", "axon") or interpret_enabled()
     )
     return (
         backend_ok
-        and Lq % 128 == 0
-        and Lk % 128 == 0
+        and _flash_pad_waste_ok(Lq, Lk)
         and head_dim % 8 == 0
         and dtype in (jnp.float32, jnp.bfloat16)
         and (bias is None or N % bias.shape[0] == 0)

@@ -104,8 +104,15 @@ def _flash_pad(tgt_len, src_len):
     """Router-side padding to the kernel's 128-multiple tile sizes:
     (pad_q, pad_k).  Padded key columns are masked out, padded query rows
     are sliced off the output — autodiff of pad/slice keeps gradients
-    exact."""
+    exact.  Shared by this router and evoformer.GatedAttention."""
     return (-tgt_len) % 128, (-src_len) % 128
+
+
+def _flash_pad_waste_ok(tgt_len, src_len):
+    """Padding must not waste more compute than the kernel saves (>37.5%
+    rejected).  One constant for every flash router."""
+    pad_q, pad_k = _flash_pad(tgt_len, src_len)
+    return (tgt_len + pad_q) * (src_len + pad_k) <= 1.6 * tgt_len * src_len
 
 
 def _flash_ok(tgt_len, src_len, head_dim, dtype):
@@ -118,9 +125,7 @@ def _flash_ok(tgt_len, src_len, head_dim, dtype):
 
     if not (jax.default_backend() in ("tpu", "axon") or interpret_enabled()):
         return False, f"backend {jax.default_backend()} is not a TPU"
-    pad_q, pad_k = _flash_pad(tgt_len, src_len)
-    padded = (tgt_len + pad_q) * (src_len + pad_k)
-    if padded > 1.6 * tgt_len * src_len:
+    if not _flash_pad_waste_ok(tgt_len, src_len):
         return False, (
             f"sequence lengths ({tgt_len}, {src_len}) are far from the "
             "kernel's 128 tile (padding would waste >37% of the compute) — "
