@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Host-only input-pipeline throughput: shards -> WordPiece tokenize ->
+BERT mask -> pad -> EpochBatchIterator, NO device in the loop.
+
+The staged half of the round-3 verdict's input-pipeline proof (#7): the
+full on-TPU check (BENCH_PIPELINE=1, <5% input wait) needs the tunnel, but
+the host-side feeding rate can be measured any time.  If this number
+comfortably exceeds the chip's training step rate (263 samples/s/chip for
+BERT-base seq 512, BASELINE.md), the pipeline cannot be the bottleneck —
+the BufferedIterator's background thread only has to keep a small buffer
+ahead of a slower consumer (the reference's bottleneck-warning contract,
+/root/reference/unicore/data/iterators.py:471-554).
+
+The timed window (40 batches) is 10x the iterator's prefetch buffer, so
+batches pre-produced during warmup cannot meaningfully inflate the rate.
+Uses the SAME task/iterator construction as bench.py's BENCH_PIPELINE=1
+mode (shared helpers), so the two modes measure one configuration.
+
+Prints one JSON line: {"metric": "input_pipeline_samples_per_sec", ...};
+the vs-chip ratio is only emitted at the default (batch 64, seq 512)
+config the 263.1 samples/s chip rate describes.
+Env: BENCH_BATCH (64), BENCH_SEQ (512), BENCH_WORKERS (2).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import make_pipeline_task, pipeline_batches  # noqa: E402
+
+BUFFER = 4  # matches pipeline_batches' data_buffer_size
+
+
+def main():
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    workers = int(os.environ.get("BENCH_WORKERS", "2"))
+    warmup, iters = 2, 10 * BUFFER  # window >> buffer: prefetch can't inflate
+
+    task, _ = make_pipeline_task(batch_size, seq_len, warmup + iters + 2)
+    gen = pipeline_batches(
+        task, batch_size, num_workers=workers, data_buffer_size=BUFFER
+    )
+    for _ in range(warmup):
+        next(gen)
+    n = 0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        batch = next(gen)
+        n += len(batch["target"])
+    dt = time.perf_counter() - t0
+    sps = n / dt
+    row = {
+        "metric": "input_pipeline_samples_per_sec",
+        "value": round(sps, 1),
+        "unit": "samples/s (host only, no device)",
+        "batch_size": batch_size,
+        "seq_len": seq_len,
+        "num_workers": workers,
+    }
+    if (batch_size, seq_len) == (64, 512):
+        # the chip rate this compares against is a seq-512/batch-64 number
+        row["vs_tpu_step_rate_263"] = round(sps / 263.1, 2)
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
