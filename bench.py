@@ -41,6 +41,17 @@ from argparse import Namespace
 
 import numpy as np
 
+# Honor the standard platform override BEFORE any jax import: with the
+# axon tunnel dead, the backend watchdog below would otherwise burn its
+# whole budget even for an explicitly-requested CPU smoke run.  The driver
+# runs bench.py WITHOUT this variable, so real-device behavior is
+# unchanged; CPU rows are labeled "device_kind": "cpu" and are not perf
+# claims.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from unicore_tpu.platform_utils import force_host_cpu_from_env
+
+force_host_cpu_from_env(default_devices=1)
+
 
 def _backend_watchdog(probe_timeout_s=120, total_budget_s=900):
     """The axon tunnel can die in a way that makes jax.devices() hang

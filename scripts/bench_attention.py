@@ -35,10 +35,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # honor the standard platform override BEFORE any jax import — without it a
 # dead axon tunnel hangs the jax.devices() probe below instead of running
 # the interpret-mode smoke
-if os.environ.get("UNICORE_TPU_PLATFORM", "").lower() == "cpu":
-    from unicore_tpu.platform_utils import force_host_cpu
+from unicore_tpu.platform_utils import force_host_cpu_from_env
 
-    force_host_cpu(int(os.environ.get("UNICORE_TPU_CPU_DEVICES", "1")))
+force_host_cpu_from_env(default_devices=1)
 
 REPS = int(os.environ.get("BENCH_ATTN_REPS", "30"))
 PARTIAL = os.path.join(

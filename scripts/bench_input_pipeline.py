@@ -11,8 +11,9 @@ the BufferedIterator's background thread only has to keep a small buffer
 ahead of a slower consumer (the reference's bottleneck-warning contract,
 /root/reference/unicore/data/iterators.py:471-554).
 
-The timed window (40 batches) is 10x the iterator's prefetch buffer, so
-batches pre-produced during warmup cannot meaningfully inflate the rate.
+The warmup consumes the full pre-production depth (data_buffer_size plus
+the loader's ~2 in-flight batches per worker) and the timed window is 10x
+that depth, so batches pre-produced before t0 cannot inflate the rate.
 Uses the SAME task/iterator construction as bench.py's BENCH_PIPELINE=1
 mode (shared helpers), so the two modes measure one configuration.
 
@@ -29,7 +30,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import make_pipeline_task, pipeline_batches  # noqa: E402
+from bench import _append_partial, make_pipeline_task, pipeline_batches  # noqa: E402
 
 BUFFER = 4  # matches pipeline_batches' data_buffer_size
 
@@ -38,7 +39,11 @@ def main():
     batch_size = int(os.environ.get("BENCH_BATCH", "64"))
     seq_len = int(os.environ.get("BENCH_SEQ", "512"))
     workers = int(os.environ.get("BENCH_WORKERS", "2"))
-    warmup, iters = 2, 10 * BUFFER  # window >> buffer: prefetch can't inflate
+    # pre-production depth: the BufferedIterator queue plus ~2 in-flight
+    # batches per loader worker (data/iterators.py) — warm through ALL of
+    # it, then time a window 10x deeper than it
+    depth = BUFFER + 2 * workers
+    warmup, iters = depth, 10 * depth
 
     task, _ = make_pipeline_task(batch_size, seq_len, warmup + iters + 2)
     gen = pipeline_batches(
@@ -65,6 +70,7 @@ def main():
         # the chip rate this compares against is a seq-512/batch-64 number
         row["vs_tpu_step_rate_263"] = round(sps / 263.1, 2)
     print(json.dumps(row))
+    _append_partial(row)  # same crash-resilience convention as bench.py
 
 
 if __name__ == "__main__":

@@ -25,3 +25,18 @@ def force_host_cpu(n_devices: int = 8) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+
+
+def force_host_cpu_from_env(default_devices: int = 8) -> bool:
+    """Apply the standard CPU-platform override when the operator set
+    ``UNICORE_TPU_PLATFORM=cpu`` (device count from
+    ``UNICORE_TPU_CPU_DEVICES``, else ``default_devices``).  One shared
+    implementation for every entry point (CLI, bench.py, bench scripts) —
+    must run BEFORE any jax import, or a dead axon tunnel hangs device
+    probes.  Returns True when the override engaged."""
+    if os.environ.get("UNICORE_TPU_PLATFORM", "").lower() != "cpu":
+        return False
+    force_host_cpu(
+        int(os.environ.get("UNICORE_TPU_CPU_DEVICES", str(default_devices)))
+    )
+    return True

@@ -433,10 +433,9 @@ def cli_main(modify_parser: Optional[Callable] = None) -> None:
     # backend init (UNICORE_TPU_CPU_DEVICES sets its size, default 8) —
     # lets the example scripts and smoke runs proceed when no accelerator
     # is reachable; see platform_utils for why JAX_PLATFORMS alone fails.
-    if os.environ.get("UNICORE_TPU_PLATFORM", "").lower() == "cpu":
-        from unicore_tpu.platform_utils import force_host_cpu
+    from unicore_tpu.platform_utils import force_host_cpu_from_env
 
-        force_host_cpu(int(os.environ.get("UNICORE_TPU_CPU_DEVICES", "8")))
+    force_host_cpu_from_env(default_devices=8)
 
     from unicore_tpu import options
     from unicore_tpu.distributed import utils as distributed_utils
