@@ -294,10 +294,16 @@ def add_distributed_training_args(parser, default_world_size=None):
                        help="size of the 'seq' (sequence/context-parallel) mesh axis")
     group.add_argument("--seq-parallel-impl", type=str, default="ring",
                        choices=["ring", "ulysses"],
-                       help="sequence-parallel attention strategy: 'ring' "
-                            "(ppermute k/v rotation; scales with L) or "
-                            "'ulysses' (all-to-all head sharding; full-row "
-                            "kernels, needs heads %% seq axis == 0)")
+                       help="sequence-parallel attention strategy for the "
+                            "bert family: 'ring' (ppermute k/v rotation; "
+                            "scales with L; also composes with the "
+                            "pipeline) or 'ulysses' (all-to-all head "
+                            "sharding; full-row kernels, needs heads %% "
+                            "seq axis == 0).  unimol/evoformer ignore this "
+                            "flag: their attention outputs are model "
+                            "outputs, so --seq-parallel-size row-shards "
+                            "the pair/msa streams instead (GSPMD; see "
+                            "docs/PARALLELISM.md)")
     group.add_argument("--pipeline-parallel-size", type=int, default=1, metavar="N",
                        help="size of the 'pipe' (pipeline-parallel) mesh axis")
     group.add_argument("--expert-parallel-size", type=int, default=1, metavar="N",
