@@ -613,3 +613,153 @@ def test_evoformer_refuses_seq_plus_pipeline():
     )
     with pytest.raises(ValueError, match="does not compose"):
         EvoformerModel.build_model(args, _T())
+
+
+# ---------------------------------------------------------------------------
+# seq-sharded flash route (round-4 verdict #2): with seq_shard on, evoformer
+# attention keeps running the Pallas kernel — per shard, inside a shard_map
+# over 'seq' — instead of surrendering to the O(L^2) XLA path.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _interpret_kernels():
+    from unicore_tpu.ops import flash_attention as fa
+    from unicore_tpu.ops._pallas import interpret_enabled
+
+    prev = interpret_enabled()
+    # match _flash_ok's backend set: on real hardware ('tpu' OR 'axon')
+    # these tests must exercise the actual Mosaic lowering, not interpret
+    fa.set_interpret(jax.default_backend() not in ("tpu", "axon"))
+    yield
+    fa.set_interpret(prev)
+
+
+def _gated_sharded_vs_xla(mod_sharded, mod_xla, inputs, tol=2e-4):
+    """Init once, run the seq-sharded kernel route and the (route-proven)
+    XLA fallback on the same params; outputs and grads wrt params AND
+    array inputs must agree."""
+    from unicore_tpu.modules import evoformer as evo
+
+    params = mod_sharded.init({"params": jax.random.PRNGKey(0)}, *inputs)
+
+    evo._ROUTE_STATS.clear()
+    run_s = jax.jit(lambda p, *a: mod_sharded.apply(p, *a))
+    out_s = run_s(params, *inputs)
+    assert evo._ROUTE_STATS.get("seq_flash", 0) >= 1, evo._ROUTE_STATS
+    out_x = jax.jit(lambda p, *a: mod_xla.apply(p, *a))(params, *inputs)
+    scale = float(jnp.abs(out_x).max()) + 1e-6
+    assert float(jnp.abs(out_s - out_x).max()) / scale < tol
+
+    # grads wrt params and the differentiable array inputs (q_x/kv_x/bias)
+    def loss(mod):
+        def f(p, *a):
+            return jnp.sum(mod.apply(p, *a) ** 2)
+        return f
+
+    n_diff = min(3, len(inputs)) + 1  # params, q_x, kv_x, maybe bias
+    argnums = tuple(range(n_diff))
+    g_s = jax.jit(jax.grad(loss(mod_sharded), argnums))(params, *inputs)
+    g_x = jax.jit(jax.grad(loss(mod_xla), argnums))(params, *inputs)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_s), jax.tree_util.tree_leaves(g_x)
+    ):
+        s = float(jnp.abs(b).max()) + 1e-6
+        assert float(jnp.abs(a - b).max()) / s < tol
+
+
+def test_gated_attention_seq_sharded_rows_mode(_interpret_kernels):
+    """MSA-row layout: the ATTENDED dim is sharded (GatedAttention rows
+    mode) — q splits by rows, k/v gather at the shard_map boundary, the
+    grouped bias splits on its query-row dim."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.modules.evoformer import GatedAttention
+
+    mesh = make_mesh(data=2, seq=4)
+    set_global_mesh(mesh)
+    B, R, L, D, H = 2, 2, 512, 16, 2  # L/seq = 128: per-shard tiles fit
+    r = np.random.RandomState(0)
+    q_x = jnp.asarray(r.randn(B, R, L, D), jnp.float32)
+    bias = jnp.asarray(r.randn(B, H, L, L), jnp.float32)  # G = B slabs
+    kv_mask = jnp.asarray((r.rand(B, R, L) > 0.15).astype(np.float32))
+
+    mk = lambda **kw: GatedAttention(D, H, **kw)
+    _gated_sharded_vs_xla(
+        mk(seq_dim=2),
+        mk(use_flash=False),
+        (q_x, q_x, bias, kv_mask),
+    )
+
+
+def test_gated_attention_seq_sharded_lead_mode(_interpret_kernels):
+    """Triangle-starting layout: a LEAD dim is sharded — every operand
+    (except the shared bias slab) splits, each shard runs the kernel on
+    its own lead rows with full-length attention."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    from unicore_tpu.modules.evoformer import GatedAttention
+
+    mesh = make_mesh(data=1, seq=2, devices=jax.devices()[:2])
+    set_global_mesh(mesh)
+    B, L, D, H = 1, 256, 8, 1  # pair (B, I=L, J=L, D), dim 1 sharded
+    r = np.random.RandomState(0)
+    q_x = jnp.asarray(r.randn(B, L, L, D), jnp.float32)
+    bias = jnp.asarray(r.randn(B, H, L, L), jnp.float32)
+    kv_mask = jnp.asarray((r.rand(B, L, L) > 0.15).astype(np.float32))
+
+    mk = lambda **kw: GatedAttention(D, H, **kw)
+    _gated_sharded_vs_xla(
+        mk(seq_dim=1),
+        mk(use_flash=False),
+        (q_x, q_x, bias, kv_mask),
+    )
+
+
+def test_evoformer_stack_seq_shard_keeps_kernel(_interpret_kernels):
+    """Full block under seq_shard with kernel-eligible L: MSA-row,
+    tri-start and tri-end attention all take the per-shard kernel route
+    (route counter), column attention (R=2, waste-gated) falls back to
+    XLA, and the whole sharded stack matches the unsharded XLA stack."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.modules import evoformer as evo
+    from unicore_tpu.ops import flash_attention as fa
+
+    mesh = make_mesh(data=2, seq=4)
+    set_global_mesh(mesh)
+    B, R, L = 2, 2, 512
+    mk = lambda shard: evo.EvoformerStack(
+        num_blocks=1, msa_dim=16, pair_dim=8, msa_heads=2, pair_heads=1,
+        dropout=0.0, remat=False, seq_shard=shard,
+    )
+    r = np.random.RandomState(0)
+    msa = jnp.asarray(r.randn(B, R, L, 16), jnp.float32)
+    pair = jnp.asarray(r.randn(B, L, L, 8), jnp.float32)
+    msa_mask = jnp.asarray((r.rand(B, R, L) > 0.15).astype(np.float32))
+    pair_mask = jnp.asarray((r.rand(B, L, L) > 0.15).astype(np.float32))
+    enc_s = mk(True)
+    params = enc_s.init(
+        {"params": jax.random.PRNGKey(0)}, msa, pair, msa_mask, pair_mask,
+        False,
+    )
+
+    evo._ROUTE_STATS.clear()
+    m_s, z_s = jax.jit(
+        lambda p: enc_s.apply(p, msa, pair, msa_mask, pair_mask, False)
+    )(params)
+    # msa_row (rows), tri_start (lead), tri_end (rows) ride the kernel;
+    # col attention's tiny R is waste-gated onto XLA
+    assert evo._ROUTE_STATS.get("seq_flash", 0) == 3, evo._ROUTE_STATS
+    assert evo._ROUTE_STATS.get("xla", 0) == 1, evo._ROUTE_STATS
+
+    # unsharded reference on the XLA path (interpret off closes the gate
+    # on CPU; kernel-vs-XLA parity is test_evoformer_flash's job)
+    fa.set_interpret(False)
+    set_global_mesh(None)
+    m_r, z_r = jax.jit(
+        lambda p: mk(False).apply(p, msa, pair, msa_mask, pair_mask, False)
+    )(params)
+    for a, b in ((m_s, m_r), (z_s, z_r)):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 2e-4

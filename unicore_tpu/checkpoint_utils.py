@@ -337,7 +337,21 @@ def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
     import sys
 
     if detect_checkpoint_format(path) == "torch":
-        state = load_torch_checkpoint(path)
+        try:
+            state = load_torch_checkpoint(path)
+        except Exception as torch_err:
+            # mis-sniff in the opposite direction (a native pickle whose
+            # header imitated a torch magic): give pickle one chance, and
+            # surface the ORIGINAL torch error if both fail
+            try:
+                with open(path, "rb") as f:
+                    state = pickle.load(f)
+                if not isinstance(state, dict):
+                    raise ValueError(
+                        f"not a checkpoint dict: {type(state).__name__}"
+                    )
+            except Exception:
+                raise torch_err from None
     else:
         torch_was_loaded = "torch" in sys.modules
         try:
@@ -384,12 +398,16 @@ _LEGACY_TORCH_MAGIC = (0x1950A86A20F9469CFC6C).to_bytes(10, "little")
 def detect_checkpoint_format(path) -> str:
     """``"torch"`` or ``"pickle"``, from the file header only (no
     unpickling — a native checkpoint can be multi-GB).  torch >= 1.6
-    zipfiles carry the b'PK' magic; LEGACY torch files start with a pickle
-    of torch's magic-number long, whose byte payload can't open a genuine
-    state-dict pickle."""
+    zipfiles carry the b'PK' magic; LEGACY torch files start with a
+    protocol-2 pickle of torch's magic-number long — anchored at its exact
+    offset (PROTO 2 + LONG1 + length 10 + payload) rather than searched
+    for, so a native pickle that merely CONTAINS those bytes early is not
+    mis-routed.  Residual mis-sniffs are survivable either way:
+    ``load_checkpoint_to_cpu`` retries the other loader on failure."""
     with open(path, "rb") as f:
         head = f.read(32)
-    if head[:2] == b"PK" or _LEGACY_TORCH_MAGIC in head:
+    legacy = head.startswith(b"\x80\x02\x8a\x0a" + _LEGACY_TORCH_MAGIC)
+    if head[:2] == b"PK" or legacy:
         return "torch"
     return "pickle"
 

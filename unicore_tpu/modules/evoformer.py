@@ -11,8 +11,9 @@ the same computational blocks TPU-natively:
   per leading group, indexed in-kernel — ops/flash_attention.py); the
   L x L probability matrix then never reaches HBM.  Non-128-multiple L
   rides the kernel via router padding (masked keys, sliced query rows);
-  the XLA softmax path remains as fallback only when padding would waste
-  more compute than the kernel saves, or under GSPMD seq sharding;
+  under GSPMD seq sharding the kernel runs per-shard inside a shard_map
+  (GatedAttention.seq_dim); the XLA softmax path remains as fallback only
+  when padding would waste more compute than the kernel saves;
 - MSA row attention with pair bias, MSA column attention;
 - outer-product-mean MSA -> pair update;
 - triangle multiplication (outgoing/incoming) and triangle attention
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 from unicore_tpu import utils
 from unicore_tpu.ops.softmax_dropout import softmax_dropout
 from .layer_norm import LayerNorm
+from .multihead_attention import _flash_grouped
 from .transformer_encoder import bert_init
 
 
@@ -49,15 +51,26 @@ class GatedAttention(nn.Module):
     with the grouped bias indexed in-kernel — the L x L probability matrix
     never reaches HBM (the reference fuses softmax+mask+bias around a
     materialized matrix instead, csrc/softmax_dropout/interface.cpp:37-48).
+
+    Under GSPMD row sharding (EvoformerStack.seq_shard) a bare pallas_call
+    can't be auto-partitioned; setting ``seq_dim`` to the q_x dim that is
+    row-sharded over the mesh 'seq' axis instead drops into an explicit
+    shard_map whose body runs the SAME kernel on each shard's rows (k/v
+    gathered by XLA at the shard_map boundary when the attended dim is the
+    sharded one), so sequence parallelism keeps the never-materialize
+    property instead of surrendering to the O(L^2) XLA path.
     """
 
     embed_dim: int
     num_heads: int
     gating: bool = True
-    # False forces the XLA softmax path: under GSPMD row sharding
-    # (EvoformerStack.seq_shard) a pallas_call can't be auto-partitioned,
-    # so the sharded stack runs the partitionable XLA path instead
+    # False forces the XLA softmax path (numerics fallback / tests)
     use_flash: bool = True
+    # index into q_x's dims that is row-sharded over the mesh 'seq' axis
+    # (a lead dim, or ndim-2 for the attended dim); None = unsharded.
+    # When the per-shard kernel can't engage (waste gate, dtype, backend),
+    # the partitionable XLA path runs — never a bare pallas_call.
+    seq_dim: Optional[int] = None
 
     @nn.compact
     def __call__(
@@ -98,44 +111,35 @@ class GatedAttention(nn.Module):
         N = 1
         for d in lead:
             N *= d
-        if self.use_flash and _flash_ok(N, Lq, Lk, head_dim, q.dtype, bias):
-            from unicore_tpu.ops.flash_attention import flash_attention
-
+        o = None
+        if self.use_flash and self.seq_dim is not None and _seq_axis_live():
+            plan = _seq_flash_plan(
+                self.seq_dim, lead, Lq, Lk, head_dim, q.dtype, bias
+            )
+            if plan is not None:
+                kvm = None
+                if kv_mask is not None:
+                    # kernel semantics: nonzero = masked OUT; flattened
+                    # per-shard inside the shard_map body
+                    kvm = 1 - kv_mask.astype(jnp.int32)
+                _count_route("seq_flash")
+                o = _sharded_flash(
+                    plan, self.seq_dim, q, k, v, bias, kvm, H, head_dim
+                )
+        elif self.use_flash and _flash_ok(N, Lq, Lk, head_dim, q.dtype, bias):
             kvm = None
             if kv_mask is not None:
                 # kernel semantics: nonzero = masked OUT
                 kvm = 1 - kv_mask.reshape(N, Lk).astype(jnp.int32)
-            # pad to the kernel's 128 tiles (same scheme — and the same
-            # helper — as the module router): padded keys mask out,
-            # padded query rows slice off
-            from .multihead_attention import _flash_pad
-
-            pad_q, pad_k = _flash_pad(Lq, Lk)
-            kq = q.reshape(N, H, Lq, head_dim)
-            kk = k.reshape(N, H, Lk, head_dim)
-            kv_ = v.reshape(N, H, Lk, head_dim)
-            kbias = bias
-            if pad_q or pad_k:
-                kq = jnp.pad(kq, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
-                kk = jnp.pad(kk, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-                kv_ = jnp.pad(kv_, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-                if pad_k:
-                    if kvm is None:
-                        kvm = jnp.zeros((N, Lk), jnp.int32)
-                    kvm = jnp.pad(
-                        kvm, ((0, 0), (0, pad_k)), constant_values=1
-                    )
-                if kbias is not None:
-                    kbias = jnp.pad(
-                        kbias, ((0, 0), (0, 0), (0, pad_q), (0, pad_k))
-                    )
-            o = flash_attention(
-                kq, kk, kv_,
-                bias=kbias,
-                kv_padding_mask=kvm,
-                sm_scale=1.0,  # q is pre-scaled
-            )[:, :, :Lq].reshape(*lead, H, Lq, head_dim)
-        else:
+            _count_route("flash")
+            o = _flash_grouped(
+                q.reshape(N, H, Lq, head_dim),
+                k.reshape(N, H, Lk, head_dim),
+                v.reshape(N, H, Lk, head_dim),
+                bias, kvm, Lq, Lk,
+            ).reshape(*lead, H, Lq, head_dim)
+        if o is None:
+            _count_route("xla")
             s = jnp.einsum("...hqd,...hkd->...hqk", q, k)
             if bias is not None:
                 G = bias.shape[0]
@@ -202,14 +206,153 @@ def _flash_ok(N, Lq, Lk, head_dim, dtype, bias):
     )
 
 
+# trace-time route counters keyed by 'flash' / 'seq_flash' / 'xla' — tests
+# assert the kernel path engages under sharding (clear() between traces)
+_ROUTE_STATS = {}
+
+
+def _count_route(name):
+    _ROUTE_STATS[name] = _ROUTE_STATS.get(name, 0) + 1
+
+
+def _seq_axis_live() -> bool:
+    """A global mesh exists and carries a >1 'seq' axis — only then does
+    GatedAttention.seq_dim mean anything (without one, the direct flash
+    route is safe: nothing is sharded)."""
+    from unicore_tpu.parallel.mesh import SEQ_AXIS, get_global_mesh
+
+    mesh = get_global_mesh()
+    return mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1
+
+
+def _seq_flash_plan(seq_dim, lead, Lq, Lk, head_dim, dtype, bias):
+    """Gate for running the flash kernel PER-SHARD under GSPMD row sharding
+    (GatedAttention.seq_dim): the mesh 'seq' axis must divide the sharded
+    dim, the PER-SHARD shapes must pass the same ``_flash_ok`` gate as the
+    direct route (backend, head_dim, dtype, padding-waste budget), and the
+    bias slab must stay indexable after the split (G in {1, lead[0]}).
+    Returns (mesh, rows_mode, data_axis|None) or None.
+
+    Per-shard HBM bound with S shards: the (N, H, Lq, Lk) probability
+    matrix never materializes anywhere; each shard holds O(N*H*Lq/S*hd)
+    output rows plus — in rows mode — one gathered O(N*H*Lk*hd) k/v copy,
+    vs the XLA fallback's O(N*H*Lq/S*Lk) per-shard score matrix."""
+    from unicore_tpu.parallel.mesh import (
+        DATA_AXIS, SEQ_AXIS, get_global_mesh,
+    )
+
+    mesh = get_global_mesh()
+    n_seq = 1 if mesh is None else mesh.shape.get(SEQ_AXIS, 1)
+    if n_seq <= 1:
+        return None
+    nl = len(lead)
+    if not 1 <= seq_dim <= nl:
+        return None
+    if bias is not None and bias.shape[0] not in (1, lead[0]):
+        return None
+    rows = seq_dim == nl  # the attended dim itself is sharded
+    if rows and Lq % n_seq:
+        return None
+    if not rows and lead[seq_dim] % n_seq:
+        return None
+    lq_local = Lq // n_seq if rows else Lq
+    # one eligibility predicate for both routes (bias group divisibility
+    # was checked above in its stricter per-shard form, so skip it here)
+    if not _flash_ok(1, lq_local, Lk, head_dim, dtype, None):
+        return None
+    n_data = mesh.shape.get(DATA_AXIS, 1)
+    data_ax = (
+        DATA_AXIS if n_data > 1 and lead[0] % n_data == 0 else None
+    )
+    return mesh, rows, data_ax
+
+
+def _sharded_flash(plan, seq_dim, q, k, v, bias, kvm, H, head_dim):
+    """shard_map runner for the seq-sharded flash route: splits the sharded
+    q_x dim over 'seq' (and batch over 'data' when divisible) and runs
+    :func:`_flash_grouped` on each shard.  In rows mode k/v/kv_mask ride
+    replicated in_specs, so XLA gathers them once at the shard_map boundary
+    and their cotangents are psummed by the shard_map transpose; the
+    grouped bias splits on its query-row dim instead."""
+    from jax.sharding import PartitionSpec as P
+
+    from unicore_tpu.parallel.mesh import SEQ_AXIS
+
+    mesh, rows, data_ax = plan
+    nl = q.ndim - 3
+
+    q_spec = [None] * (nl + 3)
+    q_spec[0] = data_ax
+    kv_spec = list(q_spec)
+    if rows:
+        q_spec[nl + 1] = SEQ_AXIS
+    else:
+        q_spec[seq_dim] = SEQ_AXIS
+        kv_spec[seq_dim] = SEQ_AXIS
+    specs = [P(*q_spec), P(*kv_spec), P(*kv_spec)]
+    operands = [q, k, v]
+    has_bias = bias is not None
+    has_mask = kvm is not None
+    if has_bias:
+        b_spec = [None] * 4
+        b_spec[0] = data_ax if bias.shape[0] == q.shape[0] else None
+        if rows:
+            b_spec[2] = SEQ_AXIS
+        specs.append(P(*b_spec))
+        operands.append(bias)
+    if has_mask:
+        m_spec = [None] * (nl + 1)
+        m_spec[0] = data_ax
+        if not rows:
+            m_spec[seq_dim] = SEQ_AXIS
+        specs.append(P(*m_spec))
+        operands.append(kvm)
+
+    def body(*ops):
+        q_, k_, v_ = ops[:3]
+        i = 3
+        b_ = ops[i] if has_bias else None
+        i += int(has_bias)
+        m_ = ops[i] if has_mask else None
+        lead_loc = q_.shape[:-3]
+        n_loc = 1
+        for d in lead_loc:
+            n_loc *= d
+        lq, lk = q_.shape[-2], k_.shape[-2]
+        o = _flash_grouped(
+            q_.reshape(n_loc, H, lq, head_dim),
+            k_.reshape(n_loc, H, lk, head_dim),
+            v_.reshape(n_loc, H, lk, head_dim),
+            b_,
+            None if m_ is None else m_.reshape(n_loc, lk),
+            lq, lk,
+        )
+        return o.reshape(*lead_loc, H, lq, head_dim)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=tuple(specs),
+        out_specs=P(*q_spec),
+        # pallas_call out_shapes carry no varying-across-mesh annotation
+        # (same caveat as ring_self_attention); equivalence tests cover it
+        check_vma=False,
+    )
+    return fn(*operands)
+
+
 class MSARowAttentionWithPairBias(nn.Module):
     """Attention along the residue dim of each MSA row, biased by the pair
-    representation."""
+    representation.  ``seq_shard``: the residue dim (msa dim 2 — the
+    attended dim) is row-sharded over the mesh 'seq' axis; attention runs
+    per-shard in the flash kernel with k/v gathered at the shard_map
+    boundary."""
 
     embed_dim: int
     pair_dim: int
     num_heads: int
     use_flash: bool = True
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None):
@@ -227,17 +370,21 @@ class MSARowAttentionWithPairBias(nn.Module):
         bias = pair_bias.transpose(0, 3, 1, 2)  # (B, H, L, L)
         out = GatedAttention(
             self.embed_dim, self.num_heads, use_flash=self.use_flash,
+            seq_dim=2 if self.seq_shard else None,
             name="attn",
         )(m, m, bias=bias, kv_mask=msa_mask)
         return out
 
 
 class MSAColumnAttention(nn.Module):
-    """Attention along the sequence (row) dim of each MSA column."""
+    """Attention along the sequence (row) dim of each MSA column.
+    ``seq_shard``: after the transpose the residue dim is LEAD dim 1 —
+    column attention is embarrassingly parallel over the seq shards."""
 
     embed_dim: int
     num_heads: int
     use_flash: bool = True
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, msa, msa_mask=None):
@@ -246,6 +393,7 @@ class MSAColumnAttention(nn.Module):
         col_mask = msa_mask.swapaxes(1, 2) if msa_mask is not None else None
         out = GatedAttention(
             self.embed_dim, self.num_heads, use_flash=self.use_flash,
+            seq_dim=1 if self.seq_shard else None,
             name="attn",
         )(mt, mt, kv_mask=col_mask)
         return out.swapaxes(1, 2)
@@ -341,6 +489,11 @@ class TriangleAttention(nn.Module):
     num_heads: int
     starting: bool = True
     use_flash: bool = True
+    # pair row-sharded on its lead dim 1 over the mesh 'seq' axis: for the
+    # starting node that is GatedAttention's lead dim 1 (parallel rows);
+    # for the ending node the swap moves it to the ATTENDED dim (rows mode,
+    # k/v gathered at the shard_map boundary)
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, pair, pair_mask=None):
@@ -358,6 +511,9 @@ class TriangleAttention(nn.Module):
             pm = pair_mask if self.starting else pair_mask.swapaxes(1, 2)
         out = GatedAttention(
             self.pair_dim, self.num_heads, use_flash=self.use_flash,
+            seq_dim=(
+                None if not self.seq_shard else (1 if self.starting else 2)
+            ),
             name="attn",
         )(z, z, bias=bias, kv_mask=pm)
         return out if self.starting else out.swapaxes(1, 2)
@@ -387,6 +543,10 @@ class EvoformerIteration(nn.Module):
     pair_heads: int = 4
     dropout: float = 0.1
     use_flash: bool = True
+    # streams row-sharded over the mesh 'seq' axis (msa residue dim 2,
+    # pair lead dim 1): each attention runs the flash kernel per-shard
+    # via shard_map instead of a (non-partitionable) bare pallas_call
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None, pair_mask=None, train=False):
@@ -396,12 +556,14 @@ class EvoformerIteration(nn.Module):
         msa = msa + drop_row(
             MSARowAttentionWithPairBias(
                 self.msa_dim, self.pair_dim, self.msa_heads,
-                use_flash=self.use_flash, name="msa_row_attn",
+                use_flash=self.use_flash, seq_shard=self.seq_shard,
+                name="msa_row_attn",
             )(msa, pair, msa_mask),
             deterministic=det,
         )
         msa = msa + MSAColumnAttention(
             self.msa_dim, self.msa_heads, use_flash=self.use_flash,
+            seq_shard=self.seq_shard,
             name="msa_col_attn",
         )(msa, msa_mask)
         msa = msa + Transition(self.msa_dim, name="msa_transition")(msa)
@@ -424,14 +586,16 @@ class EvoformerIteration(nn.Module):
         pair = pair + drop_row(
             TriangleAttention(
                 self.pair_dim, self.pair_heads, starting=True,
-                use_flash=self.use_flash, name="tri_attn_start",
+                use_flash=self.use_flash, seq_shard=self.seq_shard,
+                name="tri_attn_start",
             )(pair, pair_mask),
             deterministic=det,
         )
         pair = pair + drop_row(
             TriangleAttention(
                 self.pair_dim, self.pair_heads, starting=False,
-                use_flash=self.use_flash, name="tri_attn_end",
+                use_flash=self.use_flash, seq_shard=self.seq_shard,
+                name="tri_attn_end",
             )(pair, pair_mask),
             deterministic=det,
         )
@@ -458,9 +622,12 @@ class EvoformerStack(nn.Module):
     # row-shard over the mesh 'seq' axis via GSPMD constraints — msa
     # (B, R, L, D) on its residue dim, pair (B, I, J, D) on its lead-row
     # dim — so the O(L^2) pair activations distribute across devices and
-    # XLA inserts the gathers row-local attention needs.  The Pallas
-    # kernel route is disabled under sharding (a pallas_call can't be
-    # auto-partitioned); the partitionable XLA path runs instead.
+    # XLA inserts the gathers row-local attention needs.  Attention stays
+    # in the Pallas flash kernel: each GatedAttention drops into a
+    # shard_map over 'seq' whose body runs the kernel on that shard's rows
+    # (GatedAttention.seq_dim), so the per-shard probability matrix never
+    # materializes either; only kernel-ineligible shapes fall back to the
+    # partitionable XLA path.
     seq_shard: bool = False
 
     @nn.compact
@@ -478,6 +645,14 @@ class EvoformerStack(nn.Module):
         from unicore_tpu.parallel.sharding import seq_row_constrainer
 
         L = msa.shape[2]
+        if self.seq_shard:
+            # the row constrainer is derived from L = msa.shape[2] and
+            # applied to BOTH streams; a non-square pair would mis-shard
+            # with an opaque GSPMD error downstream
+            assert pair.shape[1] == pair.shape[2] == L, (
+                f"seq_shard needs a square pair matching the msa residue "
+                f"dim: msa L={L}, pair {pair.shape[1:3]}"
+            )
         shard_rows = seq_row_constrainer(L, self.seq_shard, "evoformer")
         seq_on = shard_rows.engaged
         block_cls = EvoformerIteration
@@ -494,7 +669,7 @@ class EvoformerStack(nn.Module):
                 msa_heads=self.msa_heads,
                 pair_heads=self.pair_heads,
                 dropout=self.dropout,
-                use_flash=not seq_on,
+                seq_shard=seq_on,
                 name=f"block_{i}",
             )(msa, pair, msa_mask, pair_mask, train)
             # re-pin both streams each block so the layout survives the

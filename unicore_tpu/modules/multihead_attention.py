@@ -20,7 +20,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from unicore_tpu.ops.flash_attention import flash_attention
 from unicore_tpu.ops.softmax_dropout import softmax_dropout
 
 logger = logging.getLogger(__name__)
@@ -113,6 +112,54 @@ def _flash_pad_waste_ok(tgt_len, src_len):
     rejected).  One constant for every flash router."""
     pad_q, pad_k = _flash_pad(tgt_len, src_len)
     return (tgt_len + pad_q) * (src_len + pad_k) <= 1.6 * tgt_len * src_len
+
+
+def _flash_grouped(q, k, v, bias, kvm, Lq, Lk, dropout_rate=0.0,
+                   dropout_seed=0, try_fullrow=False):
+    """Pad (N, H, L, hd) operands to the kernel's 128 tiles and run the
+    grouped flash kernel (or the fullrow one-shot variant when its row
+    budget allows and ``try_fullrow``): padded keys mask out, padded query
+    rows slice off — pad/slice autodiff keeps gradients exact.  The ONE
+    copy of the padding contract, shared by this module's router,
+    evoformer.GatedAttention's direct route, and each shard of its
+    seq-sharded route.
+
+    ``kvm``: (N, Lk) int, nonzero = masked OUT; ``bias``: grouped
+    (G, 1|H, Lq, Lk) with N % G == 0, or None."""
+    from unicore_tpu.ops.flash_attention import flash_attention
+
+    N = q.shape[0]
+    pad_q, pad_k = _flash_pad(Lq, Lk)
+    if pad_q or pad_k:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        if pad_k:  # only padded KEYS need masking out
+            if kvm is None:
+                kvm = jnp.zeros((N, Lk), jnp.int32)
+            kvm = jnp.pad(kvm, ((0, 0), (0, pad_k)), constant_values=1)
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_q), (0, pad_k)))
+    if try_fullrow:
+        # moderate rows: one-shot softmax + single-pass fused backward
+        from unicore_tpu.ops.attention_fullrow import (
+            fullrow_attention, supported as _fullrow_supported,
+        )
+
+        if _fullrow_supported(
+            Lq + pad_q, Lk + pad_k, q.shape[-1],
+            None if bias is None else bias.shape[0],
+        ):
+            return fullrow_attention(
+                q, k, v, bias=bias, kv_padding_mask=kvm,
+                dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+                sm_scale=1.0,  # q is pre-scaled
+            )[:, :, :Lq]
+    return flash_attention(
+        q, k, v, bias=bias, kv_padding_mask=kvm,
+        dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        sm_scale=1.0,  # q is pre-scaled
+    )[:, :, :Lq]
 
 
 def _flash_ok(tgt_len, src_len, head_dim, dtype):
@@ -300,54 +347,16 @@ def _attend(
                     module.make_rng("dropout"), (), 0, 2 ** 31 - 1,
                     dtype=jnp.int32,
                 )
-            # pad to the kernel's 128-multiple tiles: padded key columns
-            # mask out, padded query rows slice off (pad/slice autodiff
-            # keeps gradients exact)
-            pad_q, pad_k = _flash_pad(tgt_len, src_len)
-            kq, kk, kv_ = q, k, v
-            kmask, kbias = key_padding_mask, bias_min
-            if pad_q or pad_k:
-                kq = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
-                kk = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-                kv_ = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-                if pad_k:  # only padded KEYS need masking out
-                    if kmask is None:
-                        kmask = jnp.zeros((bsz, src_len), jnp.int32)
-                    kmask = jnp.pad(
-                        kmask.astype(jnp.int32), ((0, 0), (0, pad_k)),
-                        constant_values=1,  # nonzero = masked out
-                    )
-                if kbias is not None:
-                    kbias = jnp.pad(
-                        kbias, ((0, 0), (0, 0), (0, pad_q), (0, pad_k))
-                    )
-            # moderate rows: one-shot softmax + single-pass fused backward
-            from unicore_tpu.ops.attention_fullrow import (
-                fullrow_attention, supported as _fullrow_supported,
+            kmask = (
+                None if key_padding_mask is None
+                else key_padding_mask.astype(jnp.int32)
             )
-
-            if _fullrow_supported(
-                tgt_len + pad_q, src_len + pad_k, head_dim,
-                None if kbias is None else kbias.shape[0],
-            ):
-                o = fullrow_attention(
-                    kq, kk, kv_,
-                    bias=kbias,
-                    kv_padding_mask=kmask,
-                    dropout_rate=eff_dropout,
-                    dropout_seed=seed,
-                    sm_scale=1.0,  # q is pre-scaled
-                )
-                return o[:, :, :tgt_len], None, None
-            o = flash_attention(
-                kq, kk, kv_,
-                bias=kbias,
-                kv_padding_mask=kmask,
-                dropout_rate=eff_dropout,
-                dropout_seed=seed,
-                sm_scale=1.0,  # q is pre-scaled
+            o = _flash_grouped(
+                q, k, v, bias_min, kmask, tgt_len, src_len,
+                dropout_rate=eff_dropout, dropout_seed=seed,
+                try_fullrow=True,
             )
-            return o[:, :, :tgt_len], None, None
+            return o, None, None
 
     # fused-softmax path (materializes the attention matrix)
     attn_weights = jnp.einsum("bhqd,bhkd->bhqk", q, k)

@@ -378,13 +378,28 @@ class TransformerEncoder(nn.Module):
         mesh, n_micro, mb, batched = plan_schedule(
             self.pipeline_stages, B, self.pipeline_microbatches
         )
+        import logging
+
+        from unicore_tpu.parallel.mesh import warn_once
+
         n_seq = mesh.shape.get(SEQ_AXIS, 1)
         seq_on = self.use_ring and n_seq > 1 and L % n_seq == 0
-        if self.use_ring and n_seq > 1 and not seq_on:
-            import logging
-
-            from unicore_tpu.parallel.mesh import warn_once
-
+        if seq_on and attn_bias is not None and not (
+            attn_bias.ndim == 3
+            and attn_bias.shape[0] in (1, self.attention_heads)
+        ):
+            # mirror _ring_ok: the seq stage body treats the bias as ONE
+            # batch-independent (H|1, L, L) stationary slab sliced by query
+            # rows; a per-batch (B*H, L, L) bias would pass the ring's
+            # shape asserts but silently drop every batch beyond the first
+            seq_on = False
+            warn_once(
+                logging.getLogger(__name__),
+                f"pipelined encoder: attention bias shape "
+                f"{tuple(attn_bias.shape)} is not a batch-independent "
+                f"(H|1, L, L) slab; running replicated over the seq axis",
+            )
+        if self.use_ring and n_seq > 1 and not seq_on and L % n_seq != 0:
             warn_once(
                 logging.getLogger(__name__),
                 f"pipelined encoder: seq axis {n_seq} does not divide "

@@ -49,6 +49,14 @@ class TransformerEncoderWithPair(nn.Module):
     # XLA inserts the k/v all-gathers the row-local attention needs.  The
     # dominant (B, H, L, L) activation — the reason SP is wanted here —
     # then never materializes whole on one device.
+    #
+    # Unlike the evoformer family there is NO flash-kernel route to keep
+    # engaged under this sharding: every layer runs return_attn=True
+    # because the PRE-SOFTMAX WEIGHTS ARE THE MODEL STATE (the evolving
+    # pair representation consumed by the next layer and the coord/dist
+    # heads).  A never-materialize kernel is definitionally inapplicable —
+    # the per-shard (B, H, L/P, L) rows the XLA path writes are the
+    # sharded pair stream itself, not a fallback penalty.
     seq_shard: bool = False
 
     def setup(self):

@@ -684,6 +684,12 @@ def flash_attention(
         assert q.shape[0] % bias.shape[0] == 0, (
             f"bias batch {bias.shape[0]} must divide batch {q.shape[0]}"
         )
+        # 1 < Hb < H would silently read out-of-range head blocks (the
+        # index map clamps on TPU) — reject here, not just in the dbias
+        # backward branch
+        assert bias.shape[1] in (1, q.shape[1]), (
+            f"bias heads {bias.shape[1]} must be 1 or {q.shape[1]}"
+        )
     if kv_padding_mask is not None:
         kv_padding_mask = kv_padding_mask.astype(jnp.int32)[:, None, :]
     seed = jnp.reshape(jnp.asarray(dropout_seed, dtype=jnp.int32), (1,))
