@@ -521,28 +521,56 @@ def test_trainer_accepts_seq_shard_model():
     Trainer(args, _T(args), model, LOSS_REGISTRY["unimol"](_T(args)))
 
 
-def test_unimol_refuses_seq_plus_pipeline():
-    """--seq-parallel-size with --pipeline-parallel-size on unimol would
-    silently replicate over seq; build_model must refuse up front."""
-    from argparse import Namespace
-
-    from unicore_tpu.models.unimol import UniMolModel
-
-    class _T:
-        class _D:
-            def pad(self):
-                return 0
-
-            def __len__(self):
-                return 16
-
-        dictionary = _D()
-
-    args = Namespace(
-        seq_parallel_size=2, pipeline_parallel_size=2, arch="unimol_tiny",
+def test_pair_encoder_pipeline_composes_with_seq_shard():
+    """dp x pp x sp for the unimol family (round-4 verdict #3): gpipe goes
+    MANUAL over every mesh axis except 'seq', which stays AUTO, so the
+    row-sharded pair stream rides the pipeline ring.  Same params with
+    seq_shard on vs off (off = replicated over the live seq axis):
+    outputs and gradients must match."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.modules.transformer_encoder_with_pair import (
+        TransformerEncoderWithPair,
     )
-    with pytest.raises(ValueError, match="does not compose"):
-        UniMolModel.build_model(args, _T())
+
+    mesh = make_mesh(data=2, pipe=2, seq=2)
+    set_global_mesh(mesh)
+    B, L, D, H = 4, 32, 64, 8
+    mk = lambda shard: TransformerEncoderWithPair(
+        encoder_layers=2, embed_dim=D, ffn_embed_dim=128,
+        attention_heads=H, emb_dropout=0.0, dropout=0.0,
+        attention_dropout=0.0, activation_dropout=0.0, max_seq_len=L,
+        pipeline_stages=2, pipeline_microbatches=2, seq_shard=shard,
+    )
+    enc_s, enc_r = mk(True), mk(False)
+    r = np.random.RandomState(0)
+    emb = jnp.asarray(r.randn(B, L, D), jnp.float32)
+    bias = jnp.asarray(r.randn(B, H, L, L), jnp.float32)
+    pm = jnp.asarray(
+        (np.arange(L)[None, :] >= np.array([25, 32, 30, 28])[:, None])
+        .astype(np.float32)
+    )
+    params = enc_s.init({"params": jax.random.PRNGKey(0)}, emb, bias, pm)
+    run = lambda enc: jax.jit(lambda p: enc.apply(p, emb, bias, pm))
+    outs_s, outs_r = run(enc_s)(params), run(enc_r)(params)
+    names = ("x", "pair_rep", "delta", "x_norm", "delta_norm")
+    for name, a, b in zip(names, outs_s, outs_r):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-5, name
+
+    def loss(enc):
+        def f(p):
+            x, pr, d, xn, dn = enc.apply(p, emb, bias, pm)
+            return jnp.sum(x ** 2) + jnp.sum(d ** 2) + xn + dn
+        return f
+
+    g_s = jax.jit(jax.grad(loss(enc_s)))(params)
+    g_r = jax.jit(jax.grad(loss(enc_r)))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_s), jax.tree_util.tree_leaves(g_r)
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-5
 
 
 def test_evoformer_stack_row_sharded_seq():
@@ -593,26 +621,54 @@ def test_evoformer_stack_row_sharded_seq():
         assert float(jnp.abs(a - b).max()) / scale < 1e-5
 
 
-def test_evoformer_refuses_seq_plus_pipeline():
-    from argparse import Namespace
+def test_evoformer_pipeline_composes_with_seq_shard():
+    """dp x pp x sp for the evoformer family (round-4 verdict #3): the
+    row-sharded msa/pair streams ride the GPipe ring with 'seq' left as
+    an AUTO axis inside the pipeline shard_map.  Same params, seq_shard
+    on vs off: outputs and gradients must match."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from unicore_tpu.modules.evoformer import EvoformerStack
 
-    from unicore_tpu.models.evoformer_model import EvoformerModel
-
-    class _T:
-        class _D:
-            def pad(self):
-                return 1
-
-            def __len__(self):
-                return 28
-
-        dictionary = _D()
-
-    args = Namespace(
-        seq_parallel_size=2, pipeline_parallel_size=2, arch="evoformer_tiny",
+    mesh = make_mesh(data=2, pipe=2, seq=2)
+    set_global_mesh(mesh)
+    B, R, L = 4, 3, 16
+    mk = lambda shard: EvoformerStack(
+        num_blocks=2, msa_dim=32, pair_dim=16, msa_heads=4, pair_heads=4,
+        dropout=0.0, remat=False, pipeline_stages=2,
+        pipeline_microbatches=2, seq_shard=shard,
     )
-    with pytest.raises(ValueError, match="does not compose"):
-        EvoformerModel.build_model(args, _T())
+    enc_s, enc_r = mk(True), mk(False)
+    r = np.random.RandomState(0)
+    msa = jnp.asarray(r.randn(B, R, L, 32), jnp.float32)
+    pair = jnp.asarray(r.randn(B, L, L, 16), jnp.float32)
+    msa_mask = jnp.asarray((r.rand(B, R, L) > 0.2).astype(np.float32))
+    pair_mask = jnp.asarray((r.rand(B, L, L) > 0.2).astype(np.float32))
+    params = enc_s.init(
+        {"params": jax.random.PRNGKey(0)}, msa, pair, msa_mask, pair_mask,
+        False,
+    )
+    run = lambda enc: jax.jit(
+        lambda p: enc.apply(p, msa, pair, msa_mask, pair_mask, False)
+    )
+    (m_s, z_s), (m_r, z_r) = run(enc_s)(params), run(enc_r)(params)
+    for a, b in ((m_s, m_r), (z_s, z_r)):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-5
+
+    def loss(enc):
+        def f(p):
+            m, z = enc.apply(p, msa, pair, msa_mask, pair_mask, False)
+            return jnp.sum(m ** 2) + jnp.sum(z ** 2)
+        return f
+
+    g_s = jax.jit(jax.grad(loss(enc_s)))(params)
+    g_r = jax.jit(jax.grad(loss(enc_r)))(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_s), jax.tree_util.tree_leaves(g_r)
+    ):
+        scale = max(1.0, float(jnp.abs(b).max()))
+        assert float(jnp.abs(a - b).max()) / scale < 1e-5
 
 
 # ---------------------------------------------------------------------------

@@ -59,15 +59,6 @@ class EvoformerModel(BaseUnicoreModel):
     @classmethod
     def build_model(cls, args, task):
         evoformer_base_architecture(args)
-        if (
-            getattr(args, "seq_parallel_size", 1) > 1
-            and getattr(args, "pipeline_parallel_size", 1) > 1
-        ):
-            raise ValueError(
-                "evoformer: --seq-parallel-size > 1 does not compose with "
-                "--pipeline-parallel-size > 1 (the row-sharded streams "
-                "can't ride the uniform GPipe microbatch spec); drop one"
-            )
         return cls(
             vocab_size=len(task.dictionary),
             padding_idx=task.dictionary.pad(),

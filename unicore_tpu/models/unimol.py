@@ -172,19 +172,6 @@ class UniMolModel(BaseUnicoreModel):
     @classmethod
     def build_model(cls, args, task):
         unimol_base_architecture(args)
-        if (
-            getattr(args, "seq_parallel_size", 1) > 1
-            and getattr(args, "pipeline_parallel_size", 1) > 1
-        ):
-            # statically known at build time: the pair-stream row sharding
-            # does not compose with the GPipe microbatch layout yet, and
-            # silently replicating over seq is exactly what the Trainer's
-            # seq-axis gate exists to refuse
-            raise ValueError(
-                "unimol: --seq-parallel-size > 1 does not compose with "
-                "--pipeline-parallel-size > 1 (the row-sharded pair stream "
-                "can't ride the uniform GPipe microbatch spec); drop one"
-            )
         return cls(
             vocab_size=len(task.dictionary),
             padding_idx=task.dictionary.pad(),

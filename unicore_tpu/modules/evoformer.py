@@ -633,12 +633,6 @@ class EvoformerStack(nn.Module):
     @nn.compact
     def __call__(self, msa, pair, msa_mask=None, pair_mask=None, train=False):
         if self.pipeline_stages > 1:
-            if self.seq_shard:
-                from unicore_tpu.parallel.sharding import (
-                    warn_seq_pipeline_no_compose,
-                )
-
-                warn_seq_pipeline_no_compose("evoformer")
             return self._pipeline_forward(
                 msa, pair, msa_mask, pair_mask, train
             )
@@ -680,15 +674,32 @@ class EvoformerStack(nn.Module):
     def _pipeline_forward(self, msa, pair, msa_mask, pair_mask, train):
         """GPipe schedule: blocks stacked on a leading axis sharded over
         'pipe'; the (msa, pair) pair streams ride each microbatch tree
-        together (same shape every stage, so the ring buffer is uniform)."""
+        together (same shape every stage, so the ring buffer is uniform).
+
+        Composes with seq_shard (dp x pp x sp): gpipe goes MANUAL over
+        every mesh axis except 'seq', which stays AUTO, so the row
+        sharding that serves the non-pipelined stack (msa residue rows,
+        pair lead rows) runs inside each stage body via GSPMD.  Attention
+        inside the composed pipeline uses the partitionable XLA path (the
+        per-shard flash shard_map can't nest inside the partial-manual
+        pipeline body yet)."""
         from unicore_tpu.parallel.pipeline import gpipe, plan_schedule
+        from unicore_tpu.parallel.sharding import seq_pipeline_plan
 
         assert self.num_blocks % self.pipeline_stages == 0, (
             f"num_blocks {self.num_blocks} % stages {self.pipeline_stages}"
         )
         B, R, L, Dm = msa.shape
+        if self.seq_shard:
+            assert pair.shape[1] == pair.shape[2] == L, (
+                f"seq_shard needs a square pair matching the msa residue "
+                f"dim: msa L={L}, pair {pair.shape[1:3]}"
+            )
         mesh, n_micro, mb, batched = plan_schedule(
             self.pipeline_stages, B, self.pipeline_microbatches
+        )
+        pin, pin_inside, manual_axes = seq_pipeline_plan(
+            L, self.seq_shard, "evoformer"
         )
 
         template = EvoformerIteration(
@@ -697,6 +708,7 @@ class EvoformerStack(nn.Module):
             msa_heads=self.msa_heads,
             pair_heads=self.pair_heads,
             dropout=self.dropout,
+            use_flash=not pin.engaged,
         )
 
         def stack_init(rng):
@@ -719,8 +731,11 @@ class EvoformerStack(nn.Module):
         if pair_mask is None:
             pair_mask = jnp.ones((B, L, L), pair.dtype)
         mbs = {
-            "msa": msa.reshape(n_micro, mb, R, L, Dm),
-            "pair": pair.reshape(n_micro, mb, L, L, pair.shape[-1]),
+            # residue rows / pair lead rows pinned to 'seq' (identity when
+            # the composition isn't engaged); masks stay replicated over
+            # seq — row-local attention needs all keys
+            "msa": pin(msa.reshape(n_micro, mb, R, L, Dm), 3),
+            "pair": pin(pair.reshape(n_micro, mb, L, L, pair.shape[-1]), 2),
             "mm": msa_mask.reshape(n_micro, mb, R, L),
             "pm": pair_mask.reshape(n_micro, mb, L, L),
         }
@@ -745,7 +760,9 @@ class EvoformerStack(nn.Module):
                 m_, z_ = apply(
                     {"params": p_block}, m_, z_, mm, pm, train, rngs=rngs
                 )
-                return (m_, z_), None
+                # re-pin both streams block to block, mirroring the
+                # non-pipelined loop (layout survives the transposing ops)
+                return (pin_inside(m_, 2), pin_inside(z_, 1)), None
 
             n_local = jax.tree_util.tree_leaves(p_stack)[0].shape[0]
             (m, z), _ = jax.lax.scan(
@@ -754,7 +771,7 @@ class EvoformerStack(nn.Module):
             return {"msa": m, "pair": z, "mm": mm, "pm": pm}
 
         outs = gpipe(mesh, stage_apply, stack, mbs, {}, rng=rng,
-                     mb_spec=batched)
+                     mb_spec=batched, manual_axes=manual_axes)
         return (
             outs["msa"].reshape(B, R, L, Dm),
             outs["pair"].reshape(B, L, L, pair.shape[-1]),

@@ -126,12 +126,6 @@ class TransformerEncoderWithPair(nn.Module):
         attn_weights = None
         shard_rows = self._row_shard_constrainer(seq_len)
         if self.pipeline_stages > 1:
-            if self.seq_shard:
-                from unicore_tpu.parallel.sharding import (
-                    warn_seq_pipeline_no_compose,
-                )
-
-                warn_seq_pipeline_no_compose("pair-encoder")
             x, attn_weights = self._pipeline_forward(
                 x, pair_bias, padding_mask, train
             )
@@ -208,8 +202,16 @@ class TransformerEncoderWithPair(nn.Module):
     def _pipeline_forward(self, x, pair_bias, padding_mask, train):
         """GPipe schedule for the pair-evolving stack: each microbatch tree
         carries BOTH streams (atom x and the running pair bias), so the
-        evolved pair representation rides the ring between stages."""
+        evolved pair representation rides the ring between stages.
+
+        Composes with --seq-parallel-size (dp x pp x sp): the gpipe
+        shard_map goes MANUAL over every mesh axis except 'seq', which
+        stays AUTO — the same GSPMD row sharding that serves the
+        non-pipelined stack (atom rows / pair query rows pinned to 'seq')
+        runs inside each stage body, so the dominant (B, H, L, L) stream
+        stays distributed while riding the pipeline ring."""
         from unicore_tpu.parallel.pipeline import gpipe, plan_schedule
+        from unicore_tpu.parallel.sharding import seq_pipeline_plan
 
         assert pair_bias is not None, (
             "pipelined TransformerEncoderWithPair needs an attention-bias "
@@ -220,12 +222,19 @@ class TransformerEncoderWithPair(nn.Module):
         mesh, n_micro, mb, batched = plan_schedule(
             self.pipeline_stages, B, self.pipeline_microbatches
         )
+        pin, pin_inside, manual_axes = seq_pipeline_plan(
+            L, self.seq_shard, "pair-encoder"
+        )
         if padding_mask is None:
             padding_mask = jnp.zeros((B, L), jnp.int32)
         bias = jnp.broadcast_to(pair_bias, (B, H, L, L))
         mbs = {
-            "x": x.reshape(n_micro, mb, L, D),
-            "bias": bias.reshape(n_micro, mb, H, L, L),
+            # atom rows / pair query rows pinned to 'seq' (identity when
+            # the composition isn't engaged); the key dims stay full —
+            # row-local attention needs all keys, exactly like the
+            # non-pipelined row sharding
+            "x": pin(x.reshape(n_micro, mb, L, D), 2),
+            "bias": pin(bias.reshape(n_micro, mb, H, L, L), 3),
             "pm": padding_mask.reshape(n_micro, mb, L),
         }
         template = self._pipe_template
@@ -248,7 +257,9 @@ class TransformerEncoderWithPair(nn.Module):
                 h_, attn, _ = template.apply(
                     {"params": p_layer}, h_, b_, pm, True, train, rngs=rngs
                 )
-                return (h_, attn), None
+                # re-pin both streams layer to layer, mirroring the
+                # non-pipelined __call__ loop
+                return (pin_inside(h_, 1), pin_inside(attn, 2)), None
 
             n_local = jax.tree_util.tree_leaves(p_stack)[0].shape[0]
             (h, b), _ = jax.lax.scan(
@@ -257,7 +268,7 @@ class TransformerEncoderWithPair(nn.Module):
             return {"x": h, "bias": b, "pm": pm}
 
         outs = gpipe(mesh, stage_apply, self.pipeline_stack, mbs, {},
-                     rng=rng, mb_spec=batched)
+                     rng=rng, mb_spec=batched, manual_axes=manual_axes)
         return (
             outs["x"].reshape(B, L, D),
             outs["bias"].reshape(B, H, L, L),

@@ -71,6 +71,7 @@ def gpipe(
     pipe_axis: str = PIPE_AXIS,
     mb_spec: P = P(),
     const_specs=None,
+    manual_axes=None,
 ):
     """Run ``stage_apply`` as a GPipe pipeline.
 
@@ -94,6 +95,15 @@ def gpipe(
             ``constants`` (default: all replicated) — e.g. the stationary
             rel-pos bias sharded by query rows over 'seq' when the stage
             body runs ring attention (dp x pp x sp composition).
+        manual_axes: mesh axis names the shard_map runs MANUAL over
+            (default: all of them).  Passing e.g. every axis except 'seq'
+            leaves 'seq' AUTO: GSPMD keeps partitioning the stage body
+            over it, so row-sharded streams (evoformer/unimol) compose
+            with the pipeline by re-pinning their sharding constraints
+            INSIDE ``stage_apply`` (bare PartitionSpecs — the body's
+            context mesh has the manual axes marked) instead of needing
+            per-leaf microbatch specs.  ``mb_spec``/``const_specs`` may
+            then only mention manual axes.
 
     Returns the pipeline output microbatches, same structure/shape as
     ``microbatches``, replicated over the pipe axis.
@@ -114,6 +124,13 @@ def gpipe(
             lambda a: jnp.zeros_like(a), mb0
         )
         outs0 = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), mbs)
+        if manual_axes is not None:
+            # vma checking is on (partial-manual mode): the scan carries
+            # BECOME pipe-varying after one tick (r is pipe-varying), so
+            # the initial values must be cast to match the carry type
+            mark = lambda a: jax.lax.pcast(a, (pipe_axis,), to="varying")
+            zeros_mb = jax.tree_util.tree_map(mark, zeros_mb)
+            outs0 = jax.tree_util.tree_map(mark, outs0)
 
         def tick(carry, t):
             buf, outs = carry
@@ -182,6 +199,14 @@ def gpipe(
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=jax.tree_util.tree_map(lambda _: mb_spec, microbatches),
-        check_vma=False,
+        axis_names=(
+            frozenset() if manual_axes is None else frozenset(manual_axes)
+        ),
+        # partial-manual (manual_axes set) REQUIRES vma checking: the
+        # eager path's unmatch step otherwise builds an all-axes spec that
+        # mentions the auto axes and is rejected.  Full-manual keeps
+        # vma checking off (the stage body may contain pallas_call, whose
+        # out_shapes carry no varying-across-mesh annotation).
+        check_vma=manual_axes is not None,
     )
     return fn(*operands)

@@ -193,19 +193,63 @@ def seq_row_constrainer(seq_len: int, enabled: bool, what: str = "stream"):
     return constrain
 
 
-def warn_seq_pipeline_no_compose(what: str):
-    """One-shot warning for attention-as-output stacks asked to row-shard
-    inside the pipeline: the GPipe microbatch spec is uniform across
-    leaves, so the row-sharded stream can't ride it — the stack runs
-    replicated over the seq axis instead.  Model builders refuse the
-    combination up front; this covers direct module users."""
-    import logging
+def seq_pipeline_plan(seq_len: int, enabled: bool, what: str = "stream"):
+    """Composition plan for row-sharding a pipelined stack over the mesh
+    'seq' axis (dp x pp x sp for the attention-as-output families:
+    unimol pair encoder, evoformer).
 
-    from .mesh import warn_once
+    The pipeline's shard_map runs MANUAL over every axis EXCEPT 'seq'
+    (gpipe ``manual_axes``); 'seq' stays an AUTO (GSPMD) axis, so the same
+    row-sharding that serves the non-pipelined stacks keeps working inside
+    each stage body — no per-leaf microbatch specs needed.
 
-    warn_once(
-        logging.getLogger(__name__),
-        f"{what} seq sharding does not compose with the pipeline yet "
-        "(the GPipe microbatch spec is uniform across leaves); running "
-        "replicated over the seq axis",
-    )
+    Returns ``(pin, pin_inside, manual_axes)``:
+
+    - ``pin(t, row_dim)``: OUTER constraint pinning ``row_dim`` to 'seq'
+      (applied to the microbatch-shaped arrays before gpipe, so GSPMD
+      carries the layout across the shard_map boundary);
+    - ``pin_inside(t, row_dim)``: the same pin for use INSIDE the gpipe
+      stage body — a bare PartitionSpec, since the body's context mesh
+      marks the manual axes and a concrete-mesh NamedSharding would be
+      rejected there;
+    - ``manual_axes``: the axis-name set to pass to gpipe.
+
+    Carries ``pin.engaged`` like :func:`seq_row_constrainer`; when the
+    sharding can't engage (no live seq axis, or it doesn't divide
+    ``seq_len``) both pins are identities and ``manual_axes`` is None
+    (full-manual gpipe, replicated over seq — with a one-shot warning,
+    matching the non-pipelined helper's behavior)."""
+    from .mesh import SEQ_AXIS, get_global_mesh, warn_once
+
+    mesh = get_global_mesh()
+    n_seq = 1 if mesh is None else mesh.shape.get(SEQ_AXIS, 1)
+    if not (enabled and n_seq > 1 and seq_len % n_seq == 0):
+        if enabled and n_seq > 1:
+            warn_once(
+                logging.getLogger(__name__),
+                f"{what} seq sharding: seq axis {n_seq} does not divide "
+                f"L={seq_len}; running the pipeline replicated over seq",
+            )
+
+        def identity(t, row_dim):
+            return t
+
+        identity.engaged = False
+        return identity, identity, None
+
+    def pin(t, row_dim):
+        spec = [None] * t.ndim
+        spec[row_dim] = SEQ_AXIS
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, P(*spec))
+        )
+
+    def pin_inside(t, row_dim):
+        spec = [None] * t.ndim
+        spec[row_dim] = SEQ_AXIS
+        return jax.lax.with_sharding_constraint(t, P(*spec))
+
+    pin.engaged = True
+    pin_inside.engaged = True
+    manual_axes = frozenset(mesh.shape) - {SEQ_AXIS}
+    return pin, pin_inside, manual_axes
