@@ -42,6 +42,7 @@ _FAST_FILES = {
     "test_logging.py",
     "test_optim.py",
     "test_checkpoint_utils.py",
+    "test_lint.py",
     "test_nan_detector.py",
     "test_softmax_dropout.py",
     "test_fused_norm.py",
@@ -57,4 +58,9 @@ def pytest_collection_modifyitems(config, items):
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fast: quick smoke subset (python -m pytest -m fast)"
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: subprocess/e2e tests excluded from the tier-1 run "
+        "(python -m pytest -m 'not slow')",
     )

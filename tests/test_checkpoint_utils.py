@@ -58,6 +58,67 @@ def test_legacy_torch_checkpoint_autodetected(tmp_path):
     assert loaded["extra_state"]["epoch"] == 7
 
 
+@pytest.mark.parametrize("protocol", [2, 3, 4])
+def test_legacy_torch_checkpoint_any_pickle_protocol(tmp_path, protocol):
+    """The legacy sniff must match ANY pickle protocol byte, not just
+    torch.save's default of 2: protocol 3 keeps the same layout, protocol
+    4 inserts a FRAME opcode + length between PROTO and the magic LONG1
+    (round-5 ADVICE: the old sniff matched b'\\x80\\x02' only)."""
+    torch = pytest.importorskip("torch")
+    state = {
+        "model": {"w": torch.randn(3, 2)},
+        "extra_state": {"epoch": 11},
+    }
+    path = str(tmp_path / f"legacy_p{protocol}.pt")
+    torch.save(
+        state, path,
+        _use_new_zipfile_serialization=False,
+        pickle_protocol=protocol,
+    )
+    with open(path, "rb") as f:
+        head = f.read(2)
+    assert head != b"PK" and head[0:1] == b"\x80"
+    assert head[1] == protocol
+
+    assert checkpoint_utils.detect_checkpoint_format(path) == "torch"
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    np.testing.assert_allclose(loaded["model"]["w"], state["model"]["w"].numpy())
+    assert loaded["extra_state"]["epoch"] == 11
+
+
+def test_detect_format_survives_truncated_headers(tmp_path):
+    """Truncated/odd headers must sniff as SOMETHING (the loader's retry
+    makes mis-sniffs survivable) — never crash on short reads."""
+    for i, head in enumerate([b"", b"\x80", b"\x80\x04", b"\x80\x02\x8a",
+                              b"\x80\x05\x95", b"PK"]):
+        path = str(tmp_path / f"trunc{i}.pt")
+        with open(path, "wb") as f:
+            f.write(head)
+        assert checkpoint_utils.detect_checkpoint_format(path) in (
+            "torch", "pickle",
+        )
+
+
+def test_mis_sniffed_legacy_torch_retries_via_torch(tmp_path, monkeypatch):
+    """Residual mis-sniffs stay survivable: force the sniff to say
+    'pickle' for a protocol-4 LEGACY torch file and the loader must fall
+    through pickle.load's failure to the torch.load retry."""
+    torch = pytest.importorskip("torch")
+    state = {"model": {"w": torch.randn(2, 2)}, "extra_state": {"epoch": 5}}
+    path = str(tmp_path / "missniffed.pt")
+    torch.save(
+        state, path,
+        _use_new_zipfile_serialization=False,
+        pickle_protocol=4,
+    )
+    monkeypatch.setattr(
+        checkpoint_utils, "detect_checkpoint_format", lambda p: "pickle"
+    )
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    np.testing.assert_allclose(loaded["model"]["w"], state["model"]["w"].numpy())
+    assert loaded["extra_state"]["epoch"] == 5
+
+
 def test_plain_pickled_torch_tensors_convert(tmp_path):
     """A state dict pickled with plain pickle but carrying torch tensors
     (no torch.save involved) still converts to a numpy pytree on load."""

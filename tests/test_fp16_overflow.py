@@ -92,6 +92,26 @@ def test_scale_tolerance_defers_shrink():
     assert float(st["scale"]) < 128.0
 
 
+def test_threshold_loss_scale_floors_without_pinning():
+    """--threshold-loss-scale: the scale clamps at the threshold instead of
+    shrinking to min_loss_scale and aborting (reference semantics: a
+    thresholded run never raises FloatingPointError)."""
+    from unicore_tpu.optim.dynamic_loss_scaler import (
+        init_scale_state,
+        scale_schedule,
+    )
+
+    kw = dict(
+        scale_window=1000, min_loss_scale=1e-4, tolerance=0.0,
+        threshold_loss_scale=32.0,
+    )
+    st = init_scale_state(128.0)
+    for _ in range(20):
+        st, pinned = scale_schedule(st, jnp.asarray(True), **kw)
+        assert not bool(pinned)
+    assert float(st["scale"]) == 32.0
+
+
 def test_host_scaler_tolerance_and_min_scale():
     from unicore_tpu.optim.dynamic_loss_scaler import DynamicLossScaler
 

@@ -1,0 +1,643 @@
+"""unicore-tpu-lint: rule fixtures (>=2 positive + >=1 negative each),
+suppression comments, the registry plugin surface, the CLI, and the
+framework tree itself staying lint-clean."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from unicore_tpu.analysis import (
+    LINT_RULE_REGISTRY,
+    LintRule,
+    ModuleInfo,
+    Violation,
+    build_rules,
+    lint_paths,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(tmp_path, source, select=None, name="fixture.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return lint_paths([str(path)], rules=build_rules(select))
+
+
+def rule_names(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_item_in_jit(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.sum().item()
+        """,
+        select=["host-sync-in-jit"],
+    )
+    assert rule_names(vs) == ["host-sync-in-jit"]
+    assert ".item()" in vs[0].message
+
+
+def test_host_sync_np_asarray_reachable_from_scan(tmp_path):
+    """np.asarray in a helper REACHED from a scan body is still caught."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def leak(x):
+            return np.asarray(x)
+
+        def body(carry, x):
+            return carry + leak(x), None
+
+        def outer(xs):
+            return jax.lax.scan(body, 0.0, xs)
+        """,
+        select=["host-sync-in-jit"],
+    )
+    assert rule_names(vs) == ["host-sync-in-jit"]
+    assert "np.asarray" in vs[0].message
+
+
+def test_host_sync_float_coercion_and_device_get(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = x * 2
+            host = jax.device_get(y)
+            return float(y) + host
+        """,
+        select=["host-sync-in-jit"],
+    )
+    assert sorted(rule_names(vs)) == ["host-sync-in-jit"] * 2
+
+
+def test_host_sync_negative_outside_jit_and_static(tmp_path):
+    """Host syncs OUTSIDE traced regions are fine, as are float() of
+    closure config and int() of shape metadata inside them."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        SCALE = 2
+
+        class Cfg:
+            lr = 0.1
+
+        cfg = Cfg()
+
+        @jax.jit
+        def step(x):
+            n = int(x.shape[0])
+            s = float(SCALE)
+            return x * s * float(cfg.lr) + n
+
+        def host_eval(fn, batch):
+            out = jax.device_get(fn(batch))
+            return float(np.asarray(out).mean())
+        """,
+        select=["host-sync-in-jit"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_branch_on_traced_arg(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        select=["recompile-hazard"],
+    )
+    assert rule_names(vs) == ["recompile-hazard"]
+
+
+def test_recompile_while_on_scan_carry(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def body(carry, x):
+            while carry < x:
+                carry = carry + 1
+            return carry, None
+
+        def outer(xs):
+            return jax.lax.scan(body, 0, xs)
+        """,
+        select=["recompile-hazard"],
+    )
+    assert rule_names(vs) == ["recompile-hazard"]
+
+
+def test_recompile_unhashable_static_default(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, cfg=[1, 2]):
+            return x
+        """,
+        select=["recompile-hazard"],
+    )
+    assert rule_names(vs) == ["recompile-hazard"]
+    assert "unhashable" in vs[0].message
+
+
+def test_recompile_negative_static_patterns(tmp_path):
+    """Shape branching, is-None checks, static_argnums-declared params and
+    constant-default config flags are all legitimate compile-time dispatch."""
+    vs = run_lint(
+        tmp_path,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, training, mask=None, eps=1e-6):
+            if training:
+                x = x * 2
+            if mask is not None:
+                x = x + mask
+            if x.shape[0] > 8:
+                x = x[:8]
+            if len(x.shape) == 3:
+                x = x.sum(0)
+            if eps > 0:
+                x = x + eps
+            return x
+        """,
+        select=["recompile-hazard"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# impure-callable
+# ---------------------------------------------------------------------------
+
+
+def test_impure_np_random_in_jit(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            noise = np.random.randn(*x.shape)
+            return x + noise
+        """,
+        select=["impure-callable"],
+    )
+    assert rule_names(vs) == ["impure-callable"]
+    assert "np.random" in vs[0].message
+
+
+def test_impure_logging_print_and_self_mutation(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import logging
+        import jax
+        import flax.linen as nn
+
+        logger = logging.getLogger(__name__)
+
+        class Layer(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                self.call_count = 1
+                logger.info("tracing!")
+                print(x)
+                return x
+        """,
+        select=["impure-callable"],
+    )
+    assert sorted(rule_names(vs)) == ["impure-callable"] * 3
+
+
+def test_impure_negative_flax_setup_and_host_code(tmp_path):
+    """setup()'s self-assignment is the flax contract; host-side RNG and
+    logging outside traced regions are untouched."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import logging
+        import numpy as np
+        import flax.linen as nn
+
+        logger = logging.getLogger(__name__)
+
+        class Encoder(nn.Module):
+            def setup(self):
+                self.dense = nn.Dense(8)
+
+            def __call__(self, x):
+                return self.dense(x)
+
+        def make_batch(seed):
+            logger.info("building host batch")
+            return np.random.RandomState(seed).randn(4, 8)
+        """,
+        select=["impure-callable"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# unsafe-shard-map
+# ---------------------------------------------------------------------------
+
+
+def test_unsafe_shard_map_check_vma_false(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def run(mesh, f, x):
+            return jax.shard_map(f, mesh=mesh, in_specs=(None,),
+                                 out_specs=None, check_vma=False)(x)
+        """,
+        select=["unsafe-shard-map"],
+    )
+    assert rule_names(vs) == ["unsafe-shard-map"]
+    assert "check_vma" in vs[0].message
+
+
+def test_unsafe_shard_map_empty_axis_names(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def run(mesh, f, x):
+            return jax.shard_map(f, mesh=mesh, in_specs=(None,),
+                                 out_specs=None,
+                                 axis_names=frozenset())(x)
+        """,
+        select=["unsafe-shard-map"],
+    )
+    assert rule_names(vs) == ["unsafe-shard-map"]
+    assert "axis_names" in vs[0].message
+
+
+def test_unsafe_shard_map_negative_and_justified(tmp_path):
+    """Explicit axis names, non-literal check_vma, and the
+    jax-version-pinned justification comment all pass."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def run(mesh, f, x, manual_axes=None):
+            a = jax.shard_map(f, mesh=mesh, in_specs=(None,),
+                              out_specs=None,
+                              axis_names=frozenset(mesh.shape),
+                              check_vma=manual_axes is not None)(x)
+            b = jax.shard_map(f, mesh=mesh, in_specs=(None,),
+                              out_specs=None,
+                              check_vma=False,  # lint: jax-version-pinned
+                              )(x)
+            return a + b
+        """,
+        select=["unsafe-shard-map"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# prng-key-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_prng_reuse_two_draws_same_key(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert rule_names(vs) == ["prng-key-reuse"]
+    assert "IDENTICAL" in vs[0].message
+
+
+def test_prng_reuse_after_partial_rename(tmp_path):
+    """Splitting into NEW names doesn't sanitize further draws from the
+    original key."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def sample(key):
+            noise = jax.random.normal(key, (4,))
+            k1, k2 = jax.random.split(key)
+            mask = jax.random.bernoulli(key, 0.5, (4,))
+            return noise + mask + jax.random.normal(k1, (4,))
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert rule_names(vs) == ["prng-key-reuse"]
+
+
+def test_prng_negative_exclusive_branches(tmp_path):
+    """Consumes in mutually exclusive if/else arms can't both execute, so
+    they are not reuse; a consume straddling the arms still is."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def sample(key, training):
+            if training:
+                out = jax.random.bernoulli(key, 0.5, (4,))
+            else:
+                out = jax.random.normal(key, (4,))
+            return out
+
+        def reuse_across_arm(key, training):
+            a = jax.random.normal(key, (4,))
+            if training:
+                a = a + jax.random.uniform(key, (4,))
+            return a
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert rule_names(vs) == ["prng-key-reuse"]
+    assert vs[0].line == 14  # only the straddling consume
+
+
+def test_prng_negative_split_between_draws(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (4,))
+            key = jax.random.fold_in(key, 1)
+            b = jax.random.uniform(key, (4,))
+            k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+            c = jax.random.normal(k1, (4,))
+            d = jax.random.normal(k2, (4,))
+            return a + b + c + d
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# dead-flag
+# ---------------------------------------------------------------------------
+
+
+def test_dead_flag_detected(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        def add_args(parser):
+            parser.add_argument("--learning-rate", type=float, default=0.1)
+            parser.add_argument("--mystery-knob", type=int, default=3)
+            parser.add_argument("--other-dead", action="store_true")
+
+        def consume(args):
+            return args.learning_rate
+        """,
+        select=["dead-flag"],
+    )
+    assert rule_names(vs) == ["dead-flag", "dead-flag"]
+    assert "--mystery-knob" in vs[0].message
+    assert "--other-dead" in vs[1].message
+
+
+def test_dead_flag_explicit_dest(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        def add_args(parser):
+            parser.add_argument("--knob", dest="renamed_knob", type=int)
+
+        def consume(args):
+            return args.knob  # reads the WRONG name; dest is renamed_knob
+        """,
+        select=["dead-flag"],
+    )
+    assert rule_names(vs) == ["dead-flag"]
+    assert "renamed_knob" in vs[0].message
+
+
+def test_dead_flag_negative_read_variants(tmp_path):
+    """getattr-string reads, f-string getattr patterns, compat-table dict
+    keys, and the compat-flag annotation all count as consumption."""
+    vs = run_lint(
+        tmp_path,
+        """
+        NOOP_TABLE = {"legacy_knob": "accepted for compat"}
+
+        def add_args(parser):
+            parser.add_argument("--plain", type=int)
+            parser.add_argument("--via-getattr", type=int)
+            parser.add_argument("--legacy-knob", type=int)
+            parser.add_argument("--reset-optimizer", action="store_true")
+            parser.add_argument("--reset-meters", action="store_true")
+            # lint: compat-flag
+            parser.add_argument("--reserved-for-later", type=str)
+
+        def consume(args):
+            use(args.plain)
+            use(getattr(args, "via_getattr", None))
+            for kind in ("optimizer", "meters"):
+                use(getattr(args, f"reset_{kind}"))
+        """,
+        select=["dead-flag"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + registry + CLI + the tree itself
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_comment_on_line_above(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            # lint: host-sync-in-jit
+            return x.sum().item()
+        """,
+        select=["host-sync-in-jit"],
+    )
+    assert vs == []
+
+
+def test_custom_rule_registry_roundtrip(tmp_path):
+    """Plugins register rules with the same decorator idiom as
+    optimizers/losses; build_rules picks them up by name."""
+    import ast as ast_mod
+
+    name = "no-todo-comments-test"
+    if name not in LINT_RULE_REGISTRY.classes:
+
+        @LINT_RULE_REGISTRY.register(name)
+        class NoTodo(LintRule):
+            def __init__(self):
+                self.name = name
+
+            def check(self, module):
+                for node in ast_mod.walk(module.tree):
+                    if isinstance(node, ast_mod.Constant) and node.value == "TODO":
+                        yield Violation(
+                            self.name, module.path, node.lineno,
+                            node.col_offset, "TODO marker",
+                        )
+
+    try:
+        path = tmp_path / "todo.py"
+        path.write_text('x = "TODO"\n')
+        vs = lint_paths([str(path)], rules=build_rules([name]))
+        assert rule_names(vs) == [name]
+    finally:
+        LINT_RULE_REGISTRY.classes.pop(name, None)
+
+
+def test_parse_error_reported(tmp_path):
+    path = tmp_path / "broken.py"
+    path.write_text("def broken(:\n")
+    vs = lint_paths([str(path)], rules=build_rules(["host-sync-in-jit"]))
+    assert rule_names(vs) == ["parse-error"]
+
+
+def test_seeded_violations_of_every_rule(tmp_path):
+    """Acceptance: one fixture seeding all six rules at once — each is
+    detected by the full default rule set."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def add_args(parser):
+            parser.add_argument("--never-read", type=int)
+
+        @jax.jit
+        def step(x, key):
+            if x > 0:                                 # recompile-hazard
+                x = -x
+            noise = np.random.randn(4)                # impure-callable
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))         # prng-key-reuse
+            return float(x) + a + b + noise           # host-sync-in-jit
+
+        def run(mesh, f, x):
+            return jax.shard_map(f, mesh=mesh, in_specs=(None,),
+                                 out_specs=None,
+                                 check_vma=False)(x)  # unsafe-shard-map
+        """,
+    )
+    assert set(rule_names(vs)) == {
+        "host-sync-in-jit",
+        "recompile-hazard",
+        "impure-callable",
+        "prng-key-reuse",
+        "unsafe-shard-map",
+        "dead-flag",
+    }
+
+
+def test_cli_exit_codes(tmp_path):
+    from unicore_tpu_cli.lint import cli_main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert cli_main([str(clean)]) == 0
+
+    # a typo'd path must NOT report a clean tree (the CI gate would go
+    # green while linting nothing)
+    assert cli_main([str(tmp_path / "no_such_dir")]) == 2
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(
+        "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+    )
+    assert cli_main([str(dirty)]) == 1
+    assert cli_main([str(dirty), "--select", "no-such-rule"]) == 2
+    assert cli_main(["--list-rules"]) == 0
+
+
+def test_framework_tree_is_lint_clean():
+    """Acceptance criterion: `unicore-tpu-lint unicore_tpu/
+    unicore_tpu_cli/` exits 0 on the current tree (run in-process; the
+    console script is exercised separately below)."""
+    from unicore_tpu_cli.lint import cli_main
+
+    rc = cli_main(
+        [os.path.join(REPO, "unicore_tpu"), os.path.join(REPO, "unicore_tpu_cli")]
+    )
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_module_entry_point_subprocess():
+    """`python -m unicore_tpu.analysis` mirrors the console script."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "unicore_tpu.analysis",
+         "unicore_tpu/", "unicore_tpu_cli/"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

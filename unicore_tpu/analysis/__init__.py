@@ -1,0 +1,42 @@
+"""unicore-tpu-lint: JAX/TPU-aware static analysis for this framework.
+
+Encodes the trace-safety invariants the one-XLA-program-per-update design
+depends on (host syncs, recompile hazards, impurity, shard_map pins, PRNG
+hygiene, dead CLI flags) as registry-based AST rules.  See docs/lint.md.
+
+Usage::
+
+    unicore-tpu-lint unicore_tpu/ unicore_tpu_cli/
+    python -m unicore_tpu.analysis unicore_tpu/
+
+or programmatically::
+
+    from unicore_tpu.analysis import lint_paths
+    violations = lint_paths(["unicore_tpu/"])
+"""
+
+from unicore_tpu.analysis.core import (  # noqa: F401
+    LINT_RULE_REGISTRY,
+    LintRule,
+    ModuleInfo,
+    Violation,
+    build_rules,
+    iter_py_files,
+    lint_paths,
+    register_lint_rule,
+)
+
+# importing the rule modules registers the built-in rules
+import unicore_tpu.analysis.rules  # noqa: E402,F401
+import unicore_tpu.analysis.dead_flags  # noqa: E402,F401
+
+__all__ = [
+    "LINT_RULE_REGISTRY",
+    "LintRule",
+    "ModuleInfo",
+    "Violation",
+    "build_rules",
+    "iter_py_files",
+    "lint_paths",
+    "register_lint_rule",
+]

@@ -1,0 +1,8 @@
+"""``python -m unicore_tpu.analysis`` — same interface as unicore-tpu-lint."""
+
+import sys
+
+from unicore_tpu_cli.lint import cli_main
+
+if __name__ == "__main__":
+    sys.exit(cli_main())

@@ -1,0 +1,283 @@
+"""unicore-tpu-lint core: rule protocol, rule registry, lint driver.
+
+The framework's whole design is ONE compiled XLA program per update
+(PAPER.md; trainer.py) — a single host sync, impure callback, or
+recompile hazard inside the jitted region silently destroys it.  Those
+invariants live here as machine-checkable rules instead of review
+conventions.
+
+Architecture mirrors the rest of the codebase: rules are classes
+registered on a :class:`unicore_tpu.registry.Registry` (the same engine
+that backs optimizers/losses/tasks), so ``--user-dir`` plugins can ship
+custom rules with the identical decorator idiom::
+
+    from unicore_tpu.analysis import LintRule, register_lint_rule
+
+    @register_lint_rule("my-rule")
+    class MyRule(LintRule):
+        def check(self, module):
+            yield from ()
+
+The analysis itself is pure ``ast`` + ``tokenize``: linting a tree never
+imports or executes the code under analysis (an import-time crash in the
+linted tree cannot crash the linter).  The package does ride the
+framework's registry engine, so running the CLI needs ``unicore_tpu``
+importable.
+"""
+
+import ast
+import dataclasses
+import io
+import os
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+from unicore_tpu.registry import Registry
+
+# Suppression comments: ``# lint: <token>[, <token>...]`` on the violating
+# line or the line directly above silences any rule whose name — or one of
+# whose declared ``justifications`` — matches a token.
+_LINT_COMMENT_PREFIX = "lint:"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class LintRule:
+    """Base class for lint rules.
+
+    File-scope rules (``scope == "file"``) implement :meth:`check` and run
+    once per module; project-scope rules (``scope == "project"``) implement
+    :meth:`check_project` and see every module at once (needed for
+    cross-file analyses like dead-flag detection).
+    """
+
+    name: str = ""
+    scope: str = "file"
+    description: str = ""
+    #: extra suppression tokens accepted besides the rule name — e.g.
+    #: ``jax-version-pinned`` documents WHY a shard_map flag is pinned.
+    justifications: Sequence[str] = ()
+
+    def check(self, module: "ModuleInfo") -> Iterator[Violation]:
+        return iter(())
+
+    def check_project(
+        self, modules: Sequence["ModuleInfo"]
+    ) -> Iterator[Violation]:
+        return iter(())
+
+
+LINT_RULE_REGISTRY = Registry("lint_rule", base_class=LintRule)
+register_lint_rule = LINT_RULE_REGISTRY.register
+
+
+def dotted_name(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node) -> Optional[str]:
+    """Last segment of a Name/Attribute chain (``c`` for ``a.b.c``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class ImportAliases:
+    """Local names for the modules the rules care about."""
+
+    def __init__(self, tree: ast.AST):
+        self.numpy: Set[str] = set()
+        self.jax: Set[str] = set()
+        self.jax_random: Set[str] = set()  # `from jax import random as jr`
+        self.py_random: Set[str] = set()  # stdlib random
+        self.logging: Set[str] = set()
+        self.time: Set[str] = set()
+        #: names imported straight off jax.random (`from jax.random import split`)
+        self.jax_random_members: Set[str] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    if a.name == "numpy" or a.name.startswith("numpy."):
+                        self.numpy.add(a.asname or "numpy")
+                    elif a.name == "jax":
+                        self.jax.add(local)
+                    elif a.name == "jax.random":
+                        self.jax_random.add(a.asname or "jax")
+                    elif a.name == "random":
+                        self.py_random.add(local)
+                    elif a.name == "logging":
+                        self.logging.add(local)
+                    elif a.name == "time":
+                        self.time.add(local)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    local = a.asname or a.name
+                    if node.module == "jax" and a.name == "random":
+                        self.jax_random.add(local)
+                    elif node.module == "jax" and a.name == "numpy":
+                        pass  # jnp: device-side, not a host sync
+                    elif node.module == "jax.random":
+                        self.jax_random_members.add(local)
+                    elif node.module == "numpy":
+                        pass  # from-imports of numpy members are rare; skip
+                    elif node.module == "logging":
+                        self.logging.add(local)
+
+    def is_numpy(self, name: str) -> bool:
+        return name in self.numpy
+
+    def is_jax(self, name: str) -> bool:
+        return name in self.jax
+
+
+class ModuleInfo:
+    """One parsed source file plus the derived indexes rules consume."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = ImportAliases(self.tree)
+        self.comments = _comment_map(source)
+        self._traced = None
+
+    @property
+    def traced(self):
+        """Lazily-built :class:`~unicore_tpu.analysis.tracing.TracedIndex`."""
+        if self._traced is None:
+            from unicore_tpu.analysis.tracing import TracedIndex
+
+            self._traced = TracedIndex(self)
+        return self._traced
+
+    def suppression_tokens(self, line: int) -> Set[str]:
+        """Tokens from ``# lint: ...`` comments on ``line`` or ``line-1``."""
+        tokens: Set[str] = set()
+        for ln in (line, line - 1):
+            comment = self.comments.get(ln, "")
+            idx = comment.find(_LINT_COMMENT_PREFIX)
+            if idx < 0:
+                continue
+            body = comment[idx + len(_LINT_COMMENT_PREFIX):]
+            for tok in body.replace(";", ",").split(","):
+                tok = tok.strip()
+                if tok:
+                    tokens.add(tok)
+        return tokens
+
+    def is_suppressed(self, violation: Violation, rule: LintRule) -> bool:
+        tokens = self.suppression_tokens(violation.line)
+        if not tokens:
+            return False
+        accepted = {rule.name, *rule.justifications}
+        return bool(tokens & accepted)
+
+
+def _comment_map(source: str) -> Dict[int, str]:
+    comments: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return comments
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if not os.path.exists(path):
+            # a typo'd path silently linting ZERO files would turn the CI
+            # gate green while checking nothing — fail loudly instead
+            raise FileNotFoundError(f"lint path does not exist: {path}")
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d
+                for d in dirs
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    yield os.path.join(root, fn)
+
+
+def build_rules(select: Optional[Sequence[str]] = None) -> List[LintRule]:
+    """Instantiate registered rules (all, or the selected subset)."""
+    # importing the rule modules populates the registry
+    import unicore_tpu.analysis.dead_flags  # noqa: F401
+    import unicore_tpu.analysis.rules  # noqa: F401
+
+    names = list(LINT_RULE_REGISTRY.classes)
+    if select is not None:
+        unknown = sorted(set(select) - set(names))
+        if unknown:
+            raise ValueError(
+                f"unknown lint rule(s): {', '.join(unknown)} "
+                f"(available: {', '.join(sorted(names))})"
+            )
+        names = [n for n in names if n in set(select)]
+    return [LINT_RULE_REGISTRY.classes[n]() for n in names]
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[LintRule]] = None,
+    select: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    """Lint every ``.py`` file under ``paths``; returns sorted violations."""
+    if rules is None:
+        rules = build_rules(select)
+
+    modules: List[ModuleInfo] = []
+    violations: List[Violation] = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            modules.append(ModuleInfo(path, source))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            violations.append(
+                Violation("parse-error", path, line, 0, str(e))
+            )
+
+    by_path = {m.path: m for m in modules}
+    for rule in rules:
+        if rule.scope == "project":
+            found = rule.check_project(modules)
+        else:
+            found = (v for m in modules for v in rule.check(m))
+        for v in found:
+            mod = by_path.get(v.path)
+            if mod is not None and mod.is_suppressed(v, rule):
+                continue
+            violations.append(v)
+
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations

@@ -1,0 +1,704 @@
+"""The built-in JAX/TPU trace-safety rules.
+
+Each rule encodes one invariant the one-XLA-program-per-update design
+(trainer.py, PAPER.md) depends on.  See docs/lint.md for the rationale,
+examples, and the justification-comment escape hatches.
+"""
+
+import ast
+from typing import Iterator, Optional, Set
+
+from unicore_tpu.analysis.core import (
+    LintRule,
+    ModuleInfo,
+    Violation,
+    dotted_name,
+    register_lint_rule,
+    terminal_name,
+)
+from unicore_tpu.analysis.tracing import param_names, walk_body
+
+
+def _v(rule: "LintRule", module: ModuleInfo, node: ast.AST, msg: str) -> Violation:
+    return Violation(
+        rule.name, module.path, node.lineno, node.col_offset, msg
+    )
+
+
+# attribute reads on a traced value that are STATIC (safe to branch on)
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+
+
+def _assigned_names(fn: ast.AST) -> Set[str]:
+    """Bare names assigned anywhere in the function body (local values)."""
+    names: Set[str] = set()
+
+    def collect(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                collect(el)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    for node in walk_body(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                collect(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            collect(node.target)
+        elif isinstance(node, ast.For):
+            collect(node.target)
+        elif isinstance(node, ast.comprehension):
+            collect(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            collect(node.optional_vars)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# 1. host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+# numpy-namespace calls that materialize a traced value on the host
+_NUMPY_SYNC_FUNCS = frozenset({"asarray", "array", "copy"})
+# jax functions that force a device->host transfer
+_JAX_SYNC_FUNCS = frozenset({"device_get"})
+
+
+@register_lint_rule("host-sync-in-jit")
+class HostSyncInJit(LintRule):
+    name = "host-sync-in-jit"
+    description = (
+        "device->host synchronization inside a traced region: .item(), "
+        "float()/int() coercion, np.asarray/np.array, jax.device_get, "
+        ".block_until_ready()"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        for fn, reason in module.traced.iter_traced():
+            local_values = param_names(fn) | _assigned_names(fn)
+            for node in walk_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._sync_message(module, node, local_values)
+                if msg:
+                    yield _v(
+                        self,
+                        module,
+                        node,
+                        f"{msg} inside traced '{fn.name}' ({reason}) "
+                        "forces a host sync, breaking the single-XLA-"
+                        "program-per-update design",
+                    )
+
+    def _sync_message(
+        self, module: ModuleInfo, call: ast.Call, local_values: Set[str]
+    ) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not call.args:
+                return ".item()"
+            if func.attr == "block_until_ready":
+                return ".block_until_ready()"
+            base = func.value
+            if (
+                func.attr in _NUMPY_SYNC_FUNCS
+                and isinstance(base, ast.Name)
+                and module.aliases.is_numpy(base.id)
+            ):
+                return f"{base.id}.{func.attr}(...)"
+            if (
+                func.attr in _JAX_SYNC_FUNCS
+                and isinstance(base, ast.Name)
+                and module.aliases.is_jax(base.id)
+            ):
+                return f"{base.id}.{func.attr}(...)"
+        elif isinstance(func, ast.Name):
+            if func.id in ("float", "int", "bool") and len(call.args) == 1:
+                if self._coerces_traced_value(call.args[0], local_values):
+                    return f"{func.id}(...) coercion"
+            if func.id in _JAX_SYNC_FUNCS:
+                return f"{func.id}(...)"
+        return None
+
+    @staticmethod
+    def _coerces_traced_value(arg: ast.AST, local_values: Set[str]) -> bool:
+        """float()/int()/bool() of something that lives in the traced
+        scope.  Closure names (static config captured from the host),
+        literals, ``x.shape``-style static metadata, and call results stay
+        un-flagged — the signal case is coercing a parameter or a locally
+        computed array."""
+        if isinstance(arg, ast.Name):
+            return arg.id in local_values
+        if isinstance(arg, (ast.Attribute, ast.Subscript)):
+            # x.shape / x.shape[0]-style static metadata is safe
+            if isinstance(arg, ast.Attribute) and arg.attr in _STATIC_ATTRS:
+                return False
+            if (
+                isinstance(arg, ast.Subscript)
+                and isinstance(arg.value, ast.Attribute)
+                and arg.value.attr in _STATIC_ATTRS
+            ):
+                return False
+            # flag only chains ROOTED at a traced-scope value: float(cfg.lr)
+            # on closure config is trace-safe, float(out[0]) on a local isn't
+            node = arg
+            while isinstance(node, (ast.Attribute, ast.Subscript)):
+                node = node.value
+            return isinstance(node, ast.Name) and node.id in local_values
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 2. recompile-hazard
+# ---------------------------------------------------------------------------
+
+# call wrappers whose results are static even when fed a traced value
+_STATIC_CALLS = frozenset({"len", "isinstance", "hasattr", "type", "id"})
+
+
+@register_lint_rule("recompile-hazard")
+class RecompileHazard(LintRule):
+    name = "recompile-hazard"
+    description = (
+        "Python control flow branching on a traced argument (concretization "
+        "error or silent per-value recompile), and jit static arguments "
+        "with unhashable (list/dict/set) defaults"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        yield from self._check_branches(module)
+        yield from self._check_static_args(module)
+
+    # -- Python branching on traced values --------------------------------
+    # Only transform ROOTS are checked: their parameters are guaranteed
+    # tracers (modulo static_argnums, honored below).  flax methods and
+    # closure-reached helpers receive a mix of traced arrays and static
+    # config, so branching on their parameters is usually the idiomatic
+    # compile-time dispatch this framework leans on — flagging it would
+    # bury the real hazards in noise.
+    def _check_branches(self, module: ModuleInfo) -> Iterator[Violation]:
+        for fn, reason in module.traced.iter_transform_roots():
+            params = param_names(fn) - self._static_param_set(fn)
+            # parameters with literal defaults are config, not arrays
+            params -= self._constant_default_params(fn)
+            if not params:
+                continue
+            for node in walk_body(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                else:
+                    continue
+                bad = self._traced_names_in_test(test, params)
+                if bad:
+                    kind = type(node).__name__.lower()
+                    yield _v(
+                        self,
+                        module,
+                        node,
+                        f"Python {kind} on traced argument(s) "
+                        f"{', '.join(sorted(bad))} of '{fn.name}' ({reason}): "
+                        "concretizes the tracer (error) or recompiles per "
+                        "value; use lax.cond/jnp.where or mark the argument "
+                        "static",
+                    )
+
+    @staticmethod
+    def _constant_default_params(fn) -> Set[str]:
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        static: Set[str] = set()
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            if isinstance(d, ast.Constant):
+                static.add(p.arg)
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if isinstance(d, ast.Constant):
+                static.add(p.arg)
+        return static
+
+    def _static_param_set(self, fn) -> Set[str]:
+        """Params declared static via static_argnums/static_argnames on the
+        function's own jit decorator."""
+        static: Set[str] = set()
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    for el in self._iter_elements(kw.value):
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, int
+                        ):
+                            if 0 <= el.value < len(pos):
+                                static.add(pos[el.value].arg)
+                elif kw.arg == "static_argnames":
+                    for el in self._iter_elements(kw.value):
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str
+                        ):
+                            static.add(el.value)
+        return static
+
+    def _traced_names_in_test(self, test: ast.AST, params: Set[str]) -> Set[str]:
+        """Param names whose VALUE (not static metadata) the test reads."""
+        # `x is None` / `x is not None` checks pytree structure — static
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return set()
+        if isinstance(test, ast.BoolOp):
+            bad: Set[str] = set()
+            for value in test.values:
+                bad |= self._traced_names_in_test(value, params)
+            return bad
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._traced_names_in_test(test.operand, params)
+
+        bad = set()
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in params):
+                continue
+            if self._in_static_context(test, node):
+                continue
+            if self._inside_call_args(test, node):
+                # the branch is on a helper's RESULT; eligibility
+                # predicates over shapes/None-ness are the common case,
+                # and the helper's own body is linted separately
+                continue
+            bad.add(node.id)
+        return bad
+
+    @staticmethod
+    def _inside_call_args(root: ast.AST, target: ast.Name) -> bool:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    if any(n is target for n in ast.walk(a)):
+                        return True
+        return False
+
+    def _in_static_context(self, root: ast.AST, target: ast.Name) -> bool:
+        """True when ``target`` only feeds static lookups (x.shape, len(x),
+        isinstance(x, ...)) within ``root``."""
+        for node in ast.walk(root):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.value is target
+                and node.attr in _STATIC_ATTRS
+            ):
+                return True
+            if isinstance(node, ast.Call):
+                fname = terminal_name(node.func)
+                if fname in _STATIC_CALLS and any(
+                    any(n is target for n in ast.walk(a)) for a in node.args
+                ):
+                    return True
+        return False
+
+    # -- unhashable static_argnums/static_argnames -------------------------
+    def _check_static_args(self, module: ModuleInfo) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in ("jit", "pjit"):
+                # also handle @partial(jax.jit, static_argnums=...)
+                if not (
+                    terminal_name(node.func) == "partial"
+                    and node.args
+                    and terminal_name(node.args[0]) in ("jit", "pjit")
+                ):
+                    continue
+            static_kws = [
+                kw
+                for kw in node.keywords
+                if kw.arg in ("static_argnums", "static_argnames")
+            ]
+            if not static_kws:
+                continue
+            target_fn = self._wrapped_function(module, node)
+            if target_fn is None:
+                continue
+            for kw in static_kws:
+                for param, default in self._static_params(target_fn, kw):
+                    if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                        yield _v(
+                            self,
+                            module,
+                            kw.value,
+                            f"static argument '{param}' of "
+                            f"'{target_fn.name}' defaults to an unhashable "
+                            f"{type(default).__name__.lower()} literal; jit "
+                            "static args must be hashable (use a tuple or "
+                            "frozenset)",
+                        )
+
+    def _wrapped_function(self, module: ModuleInfo, call: ast.Call):
+        """The locally-defined function this jit call (or partial-decorator)
+        wraps, when resolvable."""
+        # jax.jit(f, static_argnums=...) — first positional arg
+        if terminal_name(call.func) in ("jit", "pjit") and call.args:
+            name = terminal_name(call.args[0])
+            fns = module.traced.defs_by_name.get(name or "", ())
+            return fns[0] if fns else None
+        # @partial(jax.jit, static_argnums=...) used as a decorator
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if call in node.decorator_list:
+                    return node
+        return None
+
+    def _static_params(self, fn, kw: ast.keyword):
+        """(param name, default node) pairs the static_* keyword selects."""
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        # map param -> default node (aligned from the right)
+        defaults = {}
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            defaults[p.arg] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                defaults[p.arg] = d
+
+        selected = []
+        if kw.arg == "static_argnums":
+            for idx_node in self._iter_elements(kw.value):
+                if isinstance(idx_node, ast.Constant) and isinstance(
+                    idx_node.value, int
+                ):
+                    idx = idx_node.value
+                    if 0 <= idx < len(pos):
+                        selected.append(pos[idx].arg)
+        else:  # static_argnames
+            for el in self._iter_elements(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    selected.append(el.value)
+        return [(p, defaults[p]) for p in selected if p in defaults]
+
+    @staticmethod
+    def _iter_elements(node: ast.AST):
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return list(node.elts)
+        return [node]
+
+
+# ---------------------------------------------------------------------------
+# 3. impure-callable
+# ---------------------------------------------------------------------------
+
+_LOGGING_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+)
+_LOGGER_NAMES = frozenset({"logger", "LOGGER"})
+_TIME_FUNCS = frozenset({"time", "perf_counter", "monotonic", "process_time"})
+
+
+@register_lint_rule("impure-callable")
+class ImpureCallable(LintRule):
+    name = "impure-callable"
+    description = (
+        "side effects inside a traced region: np.random/stdlib random, "
+        "logging/print, wall-clock reads, attribute mutation on self — "
+        "they run once at trace time (or never again), not per step"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        for fn, reason in module.traced.iter_traced():
+            for node in walk_body(fn):
+                if isinstance(node, ast.Call):
+                    msg = self._impure_call(module, node)
+                    if msg:
+                        yield _v(
+                            self,
+                            module,
+                            node,
+                            f"{msg} inside traced '{fn.name}' ({reason}): "
+                            "executes at trace time only — hoist it out or "
+                            "use the jax equivalent (jax.random / "
+                            "jax.debug.print)",
+                        )
+                elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    if fn.name == "setup":
+                        # flax nn.Module.setup's CONTRACT is assigning
+                        # submodules/fields to self — the sanctioned
+                        # mutation; impurity elsewhere in setup (RNG,
+                        # logging, clocks) is still checked above
+                        continue
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            yield _v(
+                                self,
+                                module,
+                                node,
+                                f"mutation of self.{t.attr} inside traced "
+                                f"'{fn.name}' ({reason}): traced callables "
+                                "must be pure (use flax variables / "
+                                "carried state instead)",
+                            )
+                elif isinstance(node, ast.Global):
+                    yield _v(
+                        self,
+                        module,
+                        node,
+                        f"global statement inside traced '{fn.name}' "
+                        f"({reason}): traced callables must be pure",
+                    )
+
+    def _impure_call(self, module: ModuleInfo, call: ast.Call) -> Optional[str]:
+        func = call.func
+        dotted = dotted_name(func)
+        if dotted:
+            head = dotted.split(".", 1)[0]
+            rest = dotted.split(".")[1:]
+            if (
+                module.aliases.is_numpy(head)
+                and rest
+                and rest[0] == "random"
+            ):
+                return f"{dotted}(...) (host-side numpy RNG)"
+            if head in module.aliases.py_random and len(rest) >= 1:
+                return f"{dotted}(...) (host-side stdlib RNG)"
+            if head in module.aliases.time and rest and rest[0] in _TIME_FUNCS:
+                return f"{dotted}(...) (wall-clock read)"
+            if (
+                head in module.aliases.logging or head in _LOGGER_NAMES
+            ) and rest and rest[-1] in _LOGGING_METHODS:
+                return f"{dotted}(...) (host-side logging)"
+        if isinstance(func, ast.Name) and func.id == "print":
+            return "print(...) (host-side I/O; use jax.debug.print)"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# 4. unsafe-shard-map
+# ---------------------------------------------------------------------------
+
+
+@register_lint_rule("unsafe-shard-map")
+class UnsafeShardMap(LintRule):
+    name = "unsafe-shard-map"
+    justifications = ("jax-version-pinned",)
+    description = (
+        "shard_map with check_vma=False (varying-across-mesh checking "
+        "disabled) or an empty axis_names=frozenset() (implicit "
+        "all-axes-manual) without a '# lint: jax-version-pinned' "
+        "justification comment"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "shard_map":
+                continue
+            for kw in node.keywords:
+                if (
+                    kw.arg == "check_vma"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False
+                ):
+                    yield _v(
+                        self,
+                        module,
+                        kw.value,
+                        "shard_map(check_vma=False) disables varying-"
+                        "across-mesh checking; justify the pin with "
+                        "'# lint: jax-version-pinned' or re-enable it",
+                    )
+                elif (
+                    kw.arg == "axis_names"
+                    and isinstance(kw.value, ast.Call)
+                    and terminal_name(kw.value.func) == "frozenset"
+                    and not kw.value.args
+                    and not kw.value.keywords
+                ):
+                    yield _v(
+                        self,
+                        module,
+                        kw.value,
+                        "shard_map(axis_names=frozenset()) relies on "
+                        "empty-set-means-all semantics; pass "
+                        "frozenset(mesh.shape) explicitly (or justify "
+                        "with '# lint: jax-version-pinned')",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# 5. prng-key-reuse
+# ---------------------------------------------------------------------------
+
+_PRNG_CONSUMERS = frozenset(
+    {
+        "normal",
+        "uniform",
+        "bernoulli",
+        "randint",
+        "categorical",
+        "gumbel",
+        "truncated_normal",
+        "permutation",
+        "choice",
+        "shuffle",
+        "bits",
+        "exponential",
+        "laplace",
+        "beta",
+        "gamma",
+        "poisson",
+        "dirichlet",
+        "rademacher",
+        "orthogonal",
+        "multivariate_normal",
+        "cauchy",
+        "logistic",
+        "ball",
+    }
+)
+
+
+@register_lint_rule("prng-key-reuse")
+class PrngKeyReuse(LintRule):
+    name = "prng-key-reuse"
+    description = (
+        "the same PRNGKey variable consumed by two random primitives "
+        "without an intervening split/fold_in — the draws are identical, "
+        "silently correlating what should be independent randomness"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, node)
+
+    def _check_function(self, module: ModuleInfo, fn) -> Iterator[Violation]:
+        # (line, col, kind, name, node, branch-context); contexts make
+        # consumes in mutually exclusive if/else arms compatible — only
+        # one of them executes, so they don't draw the same randomness
+        events = []
+        for stmt in fn.body:
+            self._collect_events(module, stmt, (), events)
+
+        consumed = {}  # var -> list of branch-contexts already consumed in
+        for _, _, kind, name, node, ctx in sorted(
+            events, key=lambda e: (e[0], e[1])
+        ):
+            if kind == "assign":
+                consumed.pop(name, None)
+                continue
+            clashes = [
+                c for c in consumed.get(name, ())
+                if not self._exclusive(c, ctx)
+            ]
+            if clashes:
+                yield _v(
+                    self,
+                    module,
+                    node,
+                    f"PRNGKey '{name}' consumed again without an "
+                    "intervening jax.random.split/fold_in in "
+                    f"'{fn.name}': both primitives draw IDENTICAL "
+                    "randomness",
+                )
+            consumed.setdefault(name, []).append(ctx)
+
+    def _collect_events(self, module: ModuleInfo, node, ctx, events) -> None:
+        """Recursive walk carrying the if/else arm context.  Called on the
+        statements/expressions INSIDE a function; stays out of nested
+        def/class scopes (they're checked as their own functions)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.If):
+            self._collect_events(module, node.test, ctx, events)
+            for arm, stmts in (("then", node.body), ("else", node.orelse)):
+                arm_ctx = ctx + ((id(node), arm),)
+                for s in stmts:
+                    self._collect_events(module, s, arm_ctx, events)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for name in self._target_names(t):
+                    events.append(
+                        (node.lineno, node.col_offset, "assign",
+                         name, node, ctx)
+                    )
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            for name in self._target_names(node.target):
+                events.append(
+                    (node.lineno, node.col_offset, "assign", name, node, ctx)
+                )
+        elif isinstance(node, ast.Call):
+            key = self._consumed_key(module, node)
+            if key:
+                events.append(
+                    (node.lineno, node.col_offset, "consume",
+                     key, node, ctx)
+                )
+        for child in ast.iter_child_nodes(node):
+            self._collect_events(module, child, ctx, events)
+
+    @staticmethod
+    def _exclusive(ctx_a, ctx_b) -> bool:
+        """True when the two branch contexts can never co-execute: they
+        diverge at a common If into different arms."""
+        for (ifid_a, arm_a), (ifid_b, arm_b) in zip(ctx_a, ctx_b):
+            if ifid_a != ifid_b:
+                return False
+            if arm_a != arm_b:
+                return True
+        return False
+
+    @staticmethod
+    def _target_names(t: ast.AST):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                if isinstance(el, ast.Name):
+                    yield el.id
+
+    def _consumed_key(self, module: ModuleInfo, call: ast.Call) -> Optional[str]:
+        """Variable name of the key this call consumes, if any."""
+        func = call.func
+        consumer = None
+        if isinstance(func, ast.Attribute) and func.attr in _PRNG_CONSUMERS:
+            base = dotted_name(func.value)
+            if base is not None:
+                head = base.split(".")[0]
+                is_jax_random = (
+                    base.endswith("random")
+                    and (
+                        module.aliases.is_jax(head)
+                        or head in module.aliases.jax_random
+                    )
+                ) or head in module.aliases.jax_random
+                if is_jax_random:
+                    consumer = func.attr
+        elif (
+            isinstance(func, ast.Name)
+            and func.id in _PRNG_CONSUMERS
+            and func.id in module.aliases.jax_random_members
+        ):
+            consumer = func.id
+        if consumer is None:
+            return None
+        if call.args and isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+        for kw in call.keywords:
+            if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                return kw.value.id
+        return None

@@ -1,0 +1,219 @@
+"""Traced-region detection: which functions in a file run under jax tracing.
+
+A function body executes under the tracer (so host syncs / impurity /
+Python branching on its values are hazards) when it is:
+
+- decorated with a trace transform (``@jax.jit``, ``@partial(jax.jit, ...)``,
+  ``@nn.compact``, ``@jax.checkpoint`` / ``remat``, ...);
+- passed to a trace wrapper call (``jax.jit(f)``, ``jax.lax.scan(f, ...)``,
+  ``jax.shard_map(f, ...)``, ``pl.pallas_call(kernel, ...)``, ...);
+- the ``__call__``/``setup`` of a flax ``nn.Module`` subclass (applied under
+  the trainer's jitted step); or
+- reachable from any of the above through same-file calls (transitive
+  closure over bare callee names — intentionally conservative: a helper
+  shared by traced and untraced callers is treated as traced, because it
+  MUST be trace-safe for the traced caller).
+
+This is a static under/over-approximation, not a proof: dynamic dispatch
+and cross-file calls are invisible.  The rules that consume it accept that
+trade — they encode conventions, and `# lint: <rule>` comments are the
+escape hatch for deliberate exceptions.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from unicore_tpu.analysis.core import ModuleInfo, terminal_name
+
+# call targets whose function-valued arguments are traced
+TRACE_WRAPPER_NAMES = frozenset(
+    {
+        "jit",
+        "pjit",
+        "shard_map",
+        "scan",
+        "cond",
+        "switch",
+        "while_loop",
+        "fori_loop",
+        "associative_scan",
+        "vmap",
+        "pmap",
+        "xmap",
+        "grad",
+        "value_and_grad",
+        "linearize",
+        "vjp",
+        "jvp",
+        "checkpoint",
+        "remat",
+        "custom_jvp",
+        "custom_vjp",
+        "pallas_call",
+        "named_call",
+    }
+)
+
+# decorator terminal names that make the decorated function traced
+TRACE_DECORATOR_NAMES = TRACE_WRAPPER_NAMES | {"compact", "nowrap"}
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SCOPE_DEFS = _FUNC_DEFS + (ast.ClassDef,)
+
+
+def walk_body(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body without descending into nested def/class
+    scopes (those are traced — and reported — in their own right, or are
+    plain host code).  Lambda bodies ARE included: a lambda invoked inside
+    a traced region (e.g. via ``tree_map``) runs under the same tracer."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPE_DEFS):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_partial_of_trace_transform(call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(remat, ...)``."""
+    if terminal_name(call.func) != "partial" or not call.args:
+        return False
+    return terminal_name(call.args[0]) in TRACE_DECORATOR_NAMES
+
+
+class TracedIndex:
+    """Per-module index of traced function nodes and why they're traced.
+
+    Each traced def carries a *kind*:
+
+    - ``"transform"`` — directly wrapped by a trace transform (decorated
+      or passed to jit/scan/shard_map/...).  Its parameters ARE tracers.
+    - ``"flax"`` — an ``nn.Module`` ``__call__``/``setup``/``@compact``
+      method.  Runs under tracing, but parameters routinely mix traced
+      arrays with static config (``train=...``), so rules that reason
+      about parameter tracedness treat these more conservatively.
+    - ``"closure"`` — reached from a traced body by same-file call.  The
+      body runs under tracing, but parameters may be static values
+      (shapes, flags) computed by the caller.
+    """
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        #: bare name -> every def with that name (any nesting level)
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        self.reasons: Dict[ast.AST, str] = {}
+        self.kinds: Dict[ast.AST, str] = {}
+        self._build()
+
+    def _build(self) -> None:
+        tree = self.module.tree
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_DEFS):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+
+        # 1) trace roots: decorators
+        for node in ast.walk(tree):
+            if not isinstance(node, _FUNC_DEFS):
+                continue
+            for dec in node.decorator_list:
+                reason = self._decorator_reason(dec)
+                if reason:
+                    kind = "flax" if "compact" in reason else "transform"
+                    self._mark(node, reason, kind)
+
+        # 2) trace roots: functions passed to trace wrapper calls
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            wrapper = terminal_name(node.func)
+            if wrapper not in TRACE_WRAPPER_NAMES:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                name = None
+                if isinstance(arg, ast.Name):
+                    name = arg.id
+                elif isinstance(arg, ast.Attribute):
+                    name = arg.attr  # e.g. self._step passed to jit
+                if name:
+                    for fn in self.defs_by_name.get(name, ()):
+                        self._mark(fn, f"passed to {wrapper}", "transform")
+
+        # 3) trace roots: flax nn.Module __call__/setup methods
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(
+                terminal_name(base) == "Module" for base in node.bases
+            ):
+                continue
+            for item in node.body:
+                if isinstance(item, _FUNC_DEFS) and item.name in (
+                    "__call__",
+                    "setup",
+                ):
+                    self._mark(item, "flax nn.Module method", "flax")
+
+        # 4) transitive closure over same-file callees
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.reasons):
+                for node in walk_body(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = terminal_name(node.func)
+                    if callee is None or callee not in self.defs_by_name:
+                        continue
+                    for target in self.defs_by_name[callee]:
+                        if target not in self.reasons:
+                            self._mark(
+                                target,
+                                f"called from traced '{_fn_name(fn)}'",
+                                "closure",
+                            )
+                            changed = True
+
+    def _decorator_reason(self, dec: ast.AST) -> Optional[str]:
+        name = terminal_name(dec)
+        if name in TRACE_DECORATOR_NAMES:
+            return f"@{name}"
+        if isinstance(dec, ast.Call):
+            inner = terminal_name(dec.func)
+            if inner in TRACE_DECORATOR_NAMES:
+                return f"@{inner}(...)"
+            if _is_partial_of_trace_transform(dec):
+                return f"@partial({terminal_name(dec.args[0])}, ...)"
+        return None
+
+    def _mark(self, fn: ast.AST, reason: str, kind: str) -> None:
+        if fn not in self.reasons:
+            self.reasons[fn] = reason
+            self.kinds[fn] = kind
+
+    def iter_traced(self) -> Iterator[Tuple[ast.AST, str]]:
+        """(function node, reason) for every traced def, in source order."""
+        for fn, reason in sorted(
+            self.reasons.items(), key=lambda kv: (kv[0].lineno, kv[0].col_offset)
+        ):
+            yield fn, reason
+
+    def iter_transform_roots(self) -> Iterator[Tuple[ast.AST, str]]:
+        """Only the defs whose parameters are guaranteed tracers."""
+        for fn, reason in self.iter_traced():
+            if self.kinds.get(fn) == "transform":
+                yield fn, reason
+
+
+def param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def _fn_name(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")

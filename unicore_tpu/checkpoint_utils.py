@@ -398,15 +398,31 @@ _LEGACY_TORCH_MAGIC = (0x1950A86A20F9469CFC6C).to_bytes(10, "little")
 def detect_checkpoint_format(path) -> str:
     """``"torch"`` or ``"pickle"``, from the file header only (no
     unpickling — a native checkpoint can be multi-GB).  torch >= 1.6
-    zipfiles carry the b'PK' magic; LEGACY torch files start with a
-    protocol-2 pickle of torch's magic-number long — anchored at its exact
-    offset (PROTO 2 + LONG1 + length 10 + payload) rather than searched
-    for, so a native pickle that merely CONTAINS those bytes early is not
-    mis-routed.  Residual mis-sniffs are survivable either way:
-    ``load_checkpoint_to_cpu`` retries the other loader on failure."""
+    zipfiles carry the b'PK' magic; LEGACY torch files start with a pickle
+    of torch's magic-number long under WHATEVER protocol the writer chose
+    (torch.save defaults to 2 but accepts ``pickle_protocol``): PROTO n,
+    then for protocol >= 4 a FRAME opcode + 8-byte length, then LONG1 +
+    length 10 + payload.  Anchored at its exact offset rather than
+    searched for, so a native pickle that merely CONTAINS those bytes
+    early is not mis-routed.  Residual mis-sniffs are survivable either
+    way: ``load_checkpoint_to_cpu`` retries the other loader on failure."""
     with open(path, "rb") as f:
         head = f.read(32)
-    legacy = head.startswith(b"\x80\x02\x8a\x0a" + _LEGACY_TORCH_MAGIC)
+    long1_magic = b"\x8a\x0a" + _LEGACY_TORCH_MAGIC
+    legacy = (
+        len(head) >= 2
+        and head[0] == 0x80  # PROTO opcode, any protocol byte
+        and (
+            # protocols 2/3: LONG1 directly after PROTO
+            (head[1] in (2, 3) and head[2:].startswith(long1_magic))
+            # protocols 4/5: PROTO, FRAME + 8-byte length, then LONG1
+            or (
+                head[1] in (4, 5)
+                and head[2:3] == b"\x95"
+                and head[11:].startswith(long1_magic)
+            )
+        )
+    )
     if head[:2] == b"PK" or legacy:
         return "torch"
     return "pickle"

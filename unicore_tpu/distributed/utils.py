@@ -78,9 +78,25 @@ def distributed_init(args) -> int:
 
 def call_main(args, main, **kwargs):
     """Entry point (reference utils.py:166-189).  JAX is single-process per
-    host, so no spawn: initialize the cluster (if any) and call main."""
+    host, so no spawn: initialize the cluster (if any) and call main.
+
+    ``--suppress-crashes`` (reference options.py): swallow training
+    exceptions and return None instead of propagating, so sweep drivers
+    that call this in-process get a return value per trial rather than an
+    abort.  KeyboardInterrupt always propagates.
+    """
     distributed_init(args)
-    return main(args, **kwargs)
+    if not getattr(args, "suppress_crashes", False):
+        return main(args, **kwargs)
+    try:
+        return main(args, **kwargs)
+    except KeyboardInterrupt:
+        raise
+    except Exception:
+        logger.exception(
+            "training crashed; continuing because --suppress-crashes is set"
+        )
+        return None
 
 
 # ---------------------------------------------------------------------------

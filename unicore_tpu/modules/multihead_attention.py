@@ -34,7 +34,9 @@ def _warn_flash_fallback(reason):
     if reason in _warned_fallbacks:
         return
     _warned_fallbacks.add(reason)
-    logger.warning(
+    # trace-time logging is the POINT here: the eligibility predicates run
+    # at trace time, so warning fires once per compiled variant, not per step
+    logger.warning(  # lint: impure-callable
         f"flash attention unavailable ({reason}); using the fused-softmax "
         "path, which materializes the full attention matrix"
     )

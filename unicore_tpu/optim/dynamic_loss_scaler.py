@@ -42,6 +42,7 @@ def scale_schedule(
     scale_window=2000,
     min_loss_scale=1e-4,
     tolerance=0.0,
+    threshold_loss_scale=None,
 ):
     """One step of the schedule, branchless.
 
@@ -50,7 +51,10 @@ def scale_schedule(
     - overflow: shrink only when the overflow percentage since the last
       rescale reaches ``tolerance`` (tolerance 0 shrinks on every overflow);
     - ``pinned`` is True when a due shrink ran into ``min_loss_scale`` —
-      the caller should abort (reference raises FloatingPointError).
+      the caller should abort (reference raises FloatingPointError);
+    - ``threshold_loss_scale`` (``--threshold-loss-scale``): static floor
+      the scale never shrinks below — reference semantics: a thresholded
+      run clamps instead of aborting, so ``pinned`` stays False.
 
     Returns ``(new_state, pinned)``.
     """
@@ -65,11 +69,17 @@ def scale_schedule(
     shrink_due = overflow & (pct >= tolerance)
     grow_due = (~overflow) & ((since_overflow + 1) % scale_window == 0)
 
-    shrunk = jnp.maximum(scale / scale_factor, min_loss_scale)
+    if threshold_loss_scale is not None:
+        shrunk = jnp.maximum(
+            scale / scale_factor, max(threshold_loss_scale, min_loss_scale)
+        )
+        pinned = jnp.zeros_like(shrink_due)
+    else:
+        shrunk = jnp.maximum(scale / scale_factor, min_loss_scale)
+        pinned = shrink_due & (scale / scale_factor <= min_loss_scale)
     new_scale = jnp.where(
         shrink_due, shrunk, jnp.where(grow_due, scale * scale_factor, scale)
     )
-    pinned = shrink_due & (scale / scale_factor <= min_loss_scale)
 
     rescaled = shrink_due | grow_due
     new_state = {

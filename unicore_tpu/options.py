@@ -10,10 +10,59 @@ becomes a sharding preset, NCCL knobs become mesh shape flags).
 """
 
 import argparse
+import logging
 from typing import Callable, List, Optional
 
 from unicore_tpu import utils
 from unicore_tpu.registry import REGISTRIES
+
+logger = logging.getLogger(__name__)
+
+# Flags accepted for CLI compatibility with the torch reference whose
+# behavior is inherent (always-on) or meaningless on the TPU/XLA stack.
+# Listing a dest here is the sanctioned way to keep an accepted-but-unwired
+# flag: parse_args_and_arch warns once whenever one is set to a non-default
+# value, so scripts ported from the reference run unchanged but operators
+# learn what the flag actually does here — and the dead-flag lint rule
+# (unicore_tpu/analysis/dead_flags.py) counts this table as consumption.
+_COMPAT_NOOP_FLAGS = {
+    "allreduce_fp32_grad":
+        "no-op: gradients are always accumulated and all-reduced in fp32",
+    "fp16_no_flatten_grads": "no-op: pytree gradients are never flattened",
+    "empty_cache_freq": "no-op: XLA owns device memory; no cache to clear",
+    "all_gather_list_size":
+        "no-op: stats ride the device-side metric accumulator, not a host "
+        "gather",
+    "distributed_backend":
+        "no-op: collectives are XLA over ICI/DCN; there is no backend choice",
+    "device_id": "no-op: device placement is discovered by JAX",
+    "distributed_no_spawn": "no-op: single-process-per-host is the JAX default",
+    "bucket_cap_mb": "no-op: XLA schedules collective fusion itself",
+    "find_unused_parameters": "no-op: XLA SPMD has no unused-parameter problem",
+    "fast_stat_sync": "no-op: device-side metric accumulation is always on",
+    "broadcast_buffers":
+        "no-op: buffers are part of the replicated state pytree",
+    "nprocs_per_node": "no-op: devices per host are discovered by JAX",
+}
+
+_compat_flags_warned = set()
+
+
+def warn_compat_noop_flags(args, parser=None) -> None:
+    """Warn once per accepted-for-compat flag the user actually set.
+
+    ``parser`` supplies the defaults to compare against; without it (tests
+    building namespaces by hand) only explicitly-truthy values warn."""
+    for dest, reason in _COMPAT_NOOP_FLAGS.items():
+        if not hasattr(args, dest) or dest in _compat_flags_warned:
+            continue
+        value = getattr(args, dest)
+        default = parser.get_default(dest) if parser is not None else None
+        if value == default or (parser is None and not value):
+            continue
+        _compat_flags_warned.add(dest)
+        flag = "--" + dest.replace("_", "-")
+        logger.warning(f"{flag}={value} accepted for CLI compat; {reason}")
 
 
 def get_preprocessing_parser(default_task="translation"):
@@ -126,6 +175,8 @@ def parse_args_and_arch(
     # Apply architecture configuration (mutates args in place).
     if hasattr(args, "arch") and args.arch in ARCH_CONFIG_REGISTRY:
         ARCH_CONFIG_REGISTRY[args.arch](args)
+
+    warn_compat_noop_flags(args, parser)
 
     if parse_known:
         return args, extra
@@ -401,11 +452,16 @@ def add_checkpoint_args(parser):
 
 
 def add_common_eval_args(group):
+    # the three unconsumed flags below are reserved for the standalone
+    # eval CLI (reference validate.py parity; not yet ported)
     group.add_argument("--path", metavar="FILE",
                        help="path(s) to model file(s), colon separated")
+    # lint: compat-flag
     group.add_argument("--quiet", action="store_true", help="only print final scores")
+    # lint: compat-flag
     group.add_argument("--model-overrides", default="{}", type=str, metavar="DICT",
                        help="a dictionary used to override model args at generation")
+    # lint: compat-flag
     group.add_argument("--results-path", metavar="RESDIR", type=str, default=None,
                        help="path to save eval results")
 

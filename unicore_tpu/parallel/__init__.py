@@ -14,10 +14,12 @@ from .mesh import (  # noqa
     set_global_mesh,
 )
 from .sharding import (  # noqa
+    DDP_BACKEND_CHOICES,
     DEFAULT_TP_RULES,
     named,
     param_spec,
     params_pspecs,
+    resolve_ddp_preset,
     zero1_pspecs,
 )
 from .ring_attention import ring_attention, ring_self_attention  # noqa

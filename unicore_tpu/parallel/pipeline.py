@@ -199,8 +199,13 @@ def gpipe(
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=jax.tree_util.tree_map(lambda _: mb_spec, microbatches),
+        # full-manual runs name every mesh axis EXPLICITLY rather than
+        # leaning on empty-set-means-all semantics (which newer jax
+        # versions read as "manual over nothing")
         axis_names=(
-            frozenset() if manual_axes is None else frozenset(manual_axes)
+            frozenset(mesh.shape)
+            if manual_axes is None
+            else frozenset(manual_axes)
         ),
         # partial-manual (manual_axes set) REQUIRES vma checking: the
         # eager path's unmatch step otherwise builds an all-axes spec that

@@ -57,6 +57,42 @@ DEFAULT_PP_RULES = [
 ]
 
 
+#: ``--ddp-backend`` choices, all mapping to the same XLA-SPMD base preset
+#: (module docstring above): state lives as sharded jax.Arrays and XLA
+#: emits the gradient psums — there is no wrapper to pick.
+DDP_BACKEND_CHOICES = ("c10d", "apex", "no_c10d", "legacy_ddp")
+
+
+def resolve_ddp_preset(args) -> str:
+    """The sharding preset ``--ddp-backend`` (+ modifier flags) selects.
+
+    Every torch backend choice maps to the same replicated-DP base on TPU
+    (grads psum'd by XLA); ``--zero-shard-optimizer`` layers ZeRO-1
+    master/optimizer-state sharding on top and ``--model-parallel-size``
+    layers 2D megatron-style tensor sharding.  Returns the preset name
+    (``"replicated"``, ``"zero1"``, ``"tensor_parallel"`` or
+    ``"zero1+tensor_parallel"``) and logs the resolution once so operators
+    see what their torch-era flags actually did.
+    """
+    backend = getattr(args, "ddp_backend", "c10d")
+    if backend not in DDP_BACKEND_CHOICES:
+        raise ValueError(
+            f"unknown --ddp-backend {backend!r} "
+            f"(choices: {', '.join(DDP_BACKEND_CHOICES)})"
+        )
+    layers = []
+    if getattr(args, "zero_shard_optimizer", False):
+        layers.append("zero1")
+    if getattr(args, "model_parallel_size", 1) > 1:
+        layers.append("tensor_parallel")
+    preset = "+".join(layers) if layers else "replicated"
+    logger.info(
+        f"--ddp-backend={backend} -> XLA SPMD preset '{preset}' "
+        "(no DDP wrapper on TPU; XLA inserts the gradient collectives)"
+    )
+    return preset
+
+
 def param_spec(path: str, shape, rules=None, axis_sizes=None) -> P:
     """Partition spec for one parameter by path-rule matching.
 

@@ -89,7 +89,11 @@ class Trainer(object):
         # published globally for modules that look the mesh up at trace
         # time (ring attention's 'seq' axis, the pipeline's 'pipe' axis)
         self.mesh = make_mesh_from_args(args)
-        from unicore_tpu.parallel import set_global_mesh
+        from unicore_tpu.parallel import resolve_ddp_preset, set_global_mesh
+
+        # torch-era --ddp-backend resolves to an XLA-SPMD sharding preset
+        # (logged once so operators see what the compat flag actually did)
+        self.ddp_preset = resolve_ddp_preset(args)
 
         set_global_mesh(self.mesh)
         from unicore_tpu.parallel import SEQ_AXIS
@@ -395,6 +399,9 @@ class Trainer(object):
                 min_loss_scale=self.args.min_loss_scale,
                 tolerance=getattr(self.args, "fp16_scale_tolerance", 0.0)
                 or 0.0,
+                threshold_loss_scale=getattr(
+                    self.args, "threshold_loss_scale", None
+                ),
             )
 
         sr_rng = jax.random.fold_in(rng, 1337)  # decorrelate SR from dropout

@@ -1,0 +1,92 @@
+"""``unicore-tpu-lint`` console entry point.
+
+Exit status: 0 clean, 1 violations found, 2 usage error — so the CI gate
+is just ``unicore-tpu-lint unicore_tpu/ unicore_tpu_cli/``.
+"""
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def get_lint_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="unicore-tpu-lint",
+        description=(
+            "JAX/TPU-aware static analysis: checks the trace-safety "
+            "invariants the one-XLA-program-per-update design depends on "
+            "(see docs/lint.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["unicore_tpu/", "unicore_tpu_cli/"],
+        help="files or directories to lint (default: the framework tree)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="RULE[,RULE...]",
+        help="comma-separated rule names to run (default: all registered)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the registered rules and exit",
+    )
+    parser.add_argument(
+        "--user-dir",
+        default=None,
+        help=(
+            "path to a python module registering custom rules via "
+            "@register_lint_rule (same plugin mechanism as training)"
+        ),
+    )
+    return parser
+
+
+def cli_main(argv: Optional[List[str]] = None) -> int:
+    args = get_lint_parser().parse_args(argv)
+
+    from unicore_tpu import utils
+    from unicore_tpu.analysis import build_rules, lint_paths
+
+    utils.import_user_module(args)
+
+    try:
+        rules = build_rules(
+            select=args.select.split(",") if args.select else None
+        )
+    except ValueError as e:
+        print(f"unicore-tpu-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.name:20s} {rule.description}")
+        return 0
+
+    try:
+        violations = lint_paths(args.paths, rules=rules)
+    except FileNotFoundError as e:
+        print(f"unicore-tpu-lint: {e}", file=sys.stderr)
+        return 2
+    for v in violations:
+        print(v.format())
+    if violations:
+        print(
+            f"unicore-tpu-lint: {len(violations)} violation(s) in "
+            f"{len(set(v.path for v in violations))} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def main() -> None:
+    sys.exit(cli_main())
+
+
+if __name__ == "__main__":
+    main()
