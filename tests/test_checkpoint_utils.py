@@ -1,7 +1,10 @@
-"""Checkpoint utilities: torch .pt interop, retention pruning, merge_params."""
+"""Checkpoint utilities: torch .pt interop, retention pruning, merge_params,
+save-retry backoff, and the corrupt-checkpoint resume fallback."""
 
 import os
 import pickle
+import time
+from argparse import Namespace
 
 import numpy as np
 import pytest
@@ -189,6 +192,211 @@ def test_retention_prunes_interval_updates(tmp_path):
     assert "checkpoint_1_300.pt" in remaining
     assert "checkpoint_1_200.pt" not in remaining
     assert "checkpoint_1_100.pt" not in remaining
+
+
+# ---------------------------------------------------------------------------
+# save retry backoff + corrupt-checkpoint resume fallback (ISSUE 2 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_persistent_save_retries_with_exponential_backoff(tmp_path, monkeypatch):
+    """Transient filesystem errors (NFS blips) are retried with exponential
+    backoff, and the write eventually lands intact."""
+    calls = {"n": 0}
+    real_rename = os.rename
+
+    def flaky_rename(src, dst):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("NFS blip")
+        return real_rename(src, dst)
+
+    sleeps = []
+    monkeypatch.setattr(checkpoint_utils.os, "rename", flaky_rename)
+    monkeypatch.setattr(checkpoint_utils.time, "sleep", sleeps.append)
+
+    path = str(tmp_path / "ckpt.pt")
+    obj = {"model": {"w": np.arange(6).reshape(2, 3)}}
+    checkpoint_utils.persistent_save(obj, path, backoff=0.25)
+    assert calls["n"] == 3
+    assert sleeps == [0.25, 0.5]  # 0.25 * 2**attempt
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    np.testing.assert_array_equal(loaded["model"]["w"], obj["model"]["w"])
+
+
+def test_persistent_save_exhausted_attempts_logs_not_raises(
+    tmp_path, monkeypatch, caplog
+):
+    def always_fails(src, dst):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(checkpoint_utils.os, "rename", always_fails)
+    monkeypatch.setattr(checkpoint_utils.time, "sleep", lambda s: None)
+    path = str(tmp_path / "ckpt.pt")
+    with caplog.at_level("ERROR"):
+        checkpoint_utils.persistent_save({"x": 1}, path, attempts=2)
+    assert not os.path.exists(path)
+    assert any("disk on fire" in r.message for r in caplog.records)
+
+
+class _LoaderStubTrainer:
+    """Just enough trainer for checkpoint_utils.load_checkpoint: reads the
+    file through load_checkpoint_to_cpu (so corruption surfaces exactly as
+    in the real path) and records what finally loaded."""
+
+    checkpoint_suffix = ""
+
+    def __init__(self):
+        self.loaded_path = None
+
+    def load_checkpoint(self, path, *args, **kwargs):
+        if not os.path.exists(path):
+            return None
+        state = checkpoint_utils.load_checkpoint_to_cpu(path)
+        self.loaded_path = path
+        return state.get("extra_state")
+
+
+def _resume_args(tmp_path):
+    return Namespace(
+        save_dir=str(tmp_path),
+        restore_file="checkpoint_last.pt",
+        finetune_from_model=None,
+        optimizer_overrides="{}",
+        reset_optimizer=False,
+        reset_lr_scheduler=False,
+        reset_meters=False,
+        reset_dataloader=False,
+    )
+
+
+def _write_ckpt(path, epoch):
+    checkpoint_utils.persistent_save(
+        {
+            "model": {"w": np.full((32,), float(epoch))},
+            "extra_state": {"epoch": epoch, "train_iterator": {"epoch": epoch}},
+        },
+        path,
+    )
+    time.sleep(0.02)  # distinct mtimes for newest-first ordering
+
+
+def _truncate(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+
+
+def test_resume_falls_back_to_next_newest_on_truncation(tmp_path, caplog):
+    """A torn checkpoint_last falls back to the next-newest retained
+    checkpoint with a loud warning instead of crashing (pairs with the
+    chaos truncate-checkpoint kind)."""
+    _write_ckpt(str(tmp_path / "checkpoint_1_100.pt"), 1)
+    _write_ckpt(str(tmp_path / "checkpoint_1_200.pt"), 2)
+    _write_ckpt(str(tmp_path / "checkpoint_last.pt"), 3)
+    _truncate(str(tmp_path / "checkpoint_last.pt"))
+
+    trainer = _LoaderStubTrainer()
+    with caplog.at_level("WARNING"):
+        extra = checkpoint_utils.load_checkpoint(_resume_args(tmp_path), trainer)
+    assert trainer.loaded_path == str(tmp_path / "checkpoint_1_200.pt")
+    assert extra["epoch"] == 2
+    assert any("CHECKPOINT CORRUPT" in r.message for r in caplog.records)
+
+
+def test_resume_fallback_chains_past_multiple_corrupt_files(tmp_path):
+    _write_ckpt(str(tmp_path / "checkpoint_1_100.pt"), 1)
+    _write_ckpt(str(tmp_path / "checkpoint_1_200.pt"), 2)
+    _write_ckpt(str(tmp_path / "checkpoint_last.pt"), 3)
+    _truncate(str(tmp_path / "checkpoint_last.pt"))
+    _truncate(str(tmp_path / "checkpoint_1_200.pt"))
+
+    trainer = _LoaderStubTrainer()
+    extra = checkpoint_utils.load_checkpoint(_resume_args(tmp_path), trainer)
+    assert trainer.loaded_path == str(tmp_path / "checkpoint_1_100.pt")
+    assert extra["epoch"] == 1
+
+
+def test_resume_raises_when_no_intact_fallback_exists(tmp_path):
+    _write_ckpt(str(tmp_path / "checkpoint_last.pt"), 1)
+    _truncate(str(tmp_path / "checkpoint_last.pt"))
+    trainer = _LoaderStubTrainer()
+    with pytest.raises(checkpoint_utils.CORRUPT_CHECKPOINT_ERRORS):
+        checkpoint_utils.load_checkpoint(_resume_args(tmp_path), trainer)
+
+
+def test_explicit_restore_file_never_falls_back(tmp_path):
+    """A corrupt file the operator NAMED via --restore-file must crash —
+    silently substituting a retained checkpoint would resume from a state
+    they never chose."""
+    target = str(tmp_path / "model_step50.pt")
+    _write_ckpt(target, 9)
+    _truncate(target)
+    _write_ckpt(str(tmp_path / "checkpoint_1_100.pt"), 1)  # tempting bait
+
+    args = _resume_args(tmp_path)
+    args.restore_file = target
+    trainer = _LoaderStubTrainer()
+    with pytest.raises(checkpoint_utils.CORRUPT_CHECKPOINT_ERRORS):
+        checkpoint_utils.load_checkpoint(args, trainer)
+    assert trainer.loaded_path is None
+
+
+def test_read_io_failures_classified_as_corruption():
+    """EIO / stale-NFS OSErrors from damaged storage must enter the
+    fallback protocol (on multi-host an unclassified error would strand
+    the peers in the outcome gather)."""
+    assert issubclass(OSError, checkpoint_utils.CORRUPT_CHECKPOINT_ERRORS)
+
+
+def test_bitflip_corruption_classified_not_just_truncation(tmp_path):
+    """Bit-rot mid-stream throws an open set of exception types
+    (OverflowError, AttributeError, ...) — the parse layer must fold them
+    all into CorruptCheckpointError so the resume fallback engages."""
+    path = str(tmp_path / "ckpt.pt")
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.arange(1000, dtype=np.float32)}}, path
+    )
+    data = bytearray(open(path, "rb").read())
+    for i in range(3, 60):
+        data[i] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(checkpoint_utils.CorruptCheckpointError):
+        checkpoint_utils.load_checkpoint_to_cpu(path)
+
+
+def test_finetune_resume_falls_back(tmp_path):
+    """A finetune run RESUMING from its own torn checkpoint_last must fall
+    back — the retained checkpoints in save_dir belong to this run (only
+    the pretrained FILE itself is exempt)."""
+    pretrained = str(tmp_path / "pretrained.pt")
+    _write_ckpt(pretrained, 9)
+    _write_ckpt(str(tmp_path / "checkpoint_1_100.pt"), 1)
+    _write_ckpt(str(tmp_path / "checkpoint_last.pt"), 3)
+    _truncate(str(tmp_path / "checkpoint_last.pt"))
+
+    args = _resume_args(tmp_path)
+    args.finetune_from_model = pretrained
+    trainer = _LoaderStubTrainer()
+    extra = checkpoint_utils.load_checkpoint(args, trainer)
+    assert trainer.loaded_path == str(tmp_path / "checkpoint_1_100.pt")
+    assert extra["epoch"] == 1
+
+
+def test_finetune_start_never_falls_back(tmp_path):
+    """A corrupt --finetune-from-model file must crash, not silently resume
+    from an unrelated retained checkpoint of a different run."""
+    pretrained = str(tmp_path / "pretrained.pt")
+    _write_ckpt(pretrained, 9)
+    _truncate(pretrained)
+    _write_ckpt(str(tmp_path / "checkpoint_1_100.pt"), 1)  # tempting bait
+
+    args = _resume_args(tmp_path)
+    args.finetune_from_model = pretrained
+    trainer = _LoaderStubTrainer()
+    with pytest.raises(checkpoint_utils.CORRUPT_CHECKPOINT_ERRORS):
+        checkpoint_utils.load_checkpoint(args, trainer)
+    assert trainer.loaded_path is None
 
 
 def test_torch_export_roundtrip(tmp_path):

@@ -1,0 +1,658 @@
+"""Robustness subsystem (ISSUE 2): cross-host consistency guard, collective
+watchdog, graceful preemption, and the fault-injection harness that proves
+each guard fires with the RIGHT diagnosis — a named rank and field, thread
+stacks on a stall — not just that the happy path stays green."""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from unicore_tpu.distributed import chaos, guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_robustness_state():
+    yield
+    chaos.reset()
+    guard.reset()
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault-spec parsing + hooks
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fault_spec_forms():
+    p = chaos.parse_fault_spec("seed-skew@100")
+    assert (p.kind, p.step, p._rank, p.param) == ("seed-skew", 100, None, None)
+    p = chaos.parse_fault_spec("geometry-skew@5@1")
+    assert (p.kind, p.step, p.rank) == ("geometry-skew", 5, 1)
+    p = chaos.parse_fault_spec("collective-delay:2.5@7@0")
+    assert (p.kind, p.param, p.step, p.rank) == ("collective-delay", 2.5, 7, 0)
+
+
+def test_truncate_checkpoint_defaults_to_writer_rank():
+    """checkpoints are written by rank 0; a last-rank default would make
+    the truncate kind a silent no-op on multi-host runs."""
+    assert chaos.parse_fault_spec("truncate-checkpoint@10").rank == 0
+    assert chaos.parse_fault_spec("truncate-checkpoint@10@1").rank == 1
+
+
+def test_parse_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError):
+        chaos.parse_fault_spec("no-such-kind@1")
+    with pytest.raises(ValueError):
+        chaos.parse_fault_spec("seed-skew")
+    with pytest.raises(ValueError):
+        chaos.parse_fault_spec("seed-skew@1@2@3")
+
+
+def test_seed_skew_is_persistent_and_rank_targeted():
+    chaos.configure(Namespace(fault_inject="seed-skew@3@0"))
+    assert chaos.maybe_skew_seed(2, 7) == 7      # before the trigger step
+    assert chaos.maybe_skew_seed(3, 7) == 1007   # from the trigger on
+    assert chaos.maybe_skew_seed(9, 7) == 1007   # persistent
+    chaos.reset()
+    chaos.configure(Namespace(fault_inject="seed-skew@3@5"))  # not this rank
+    assert chaos.maybe_skew_seed(9, 7) == 7
+
+
+def test_geometry_skew_drops_a_row_and_changes_signature():
+    chaos.configure(Namespace(fault_inject="geometry-skew@0@0"))
+    batch = {
+        "net_input": {"src_tokens": np.zeros((4, 16), np.int64)},
+        "target": np.zeros((4, 16), np.int64),
+    }
+    before = guard.batch_signature(batch)
+    (perturbed,) = chaos.maybe_perturb_geometry(0, [batch])
+    after = guard.batch_signature(perturbed)
+    assert perturbed["target"].shape == (3, 16)
+    assert before != after
+
+
+def test_raise_kind_fires_exactly_once_at_step():
+    chaos.configure(Namespace(fault_inject="raise@4@0"))
+    chaos.maybe_raise(3)
+    with pytest.raises(chaos.ChaosError, match="step 4"):
+        chaos.maybe_raise(4)
+    chaos.maybe_raise(5)  # one-shot, not persistent
+
+
+def test_chaos_truncate_checkpoint_pairs_with_corrupt_loader(tmp_path):
+    """truncate-checkpoint tears the file AFTER the atomic rename; the
+    loader must classify the damage as a corrupt checkpoint (the error set
+    the resume fallback keys on)."""
+    from unicore_tpu import checkpoint_utils
+
+    chaos.configure(Namespace(fault_inject="truncate-checkpoint@0@0"))
+    chaos.note_step(5)
+    path = str(tmp_path / "checkpoint_last.pt")
+    obj = {"model": {"w": np.arange(4096, dtype=np.float32)}}
+    checkpoint_utils.persistent_save(obj, path)
+    assert 0 < os.path.getsize(path) < len(pickle.dumps(obj))
+    with pytest.raises(checkpoint_utils.CORRUPT_CHECKPOINT_ERRORS):
+        checkpoint_utils.load_checkpoint_to_cpu(path)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + diagnosis
+# ---------------------------------------------------------------------------
+
+
+def _fp(**overrides):
+    base = {
+        "config": "cfg0",
+        "seed": 7,
+        "step": 100,
+        "lr": 1e-3,
+        "loss_scale": 1.0,
+        "batch_sig": "sig0",
+        "dummy_plan": "plan0",
+    }
+    base.update(overrides)
+    return ("unicore-tpu-consistency-v1", base)
+
+
+def test_diagnose_agreeing_fingerprints_is_none():
+    assert guard.diagnose_fingerprints([_fp(), _fp(), _fp()]) is None
+
+
+def test_diagnose_names_divergent_rank_and_field():
+    msg = guard.diagnose_fingerprints([_fp(), _fp(seed=1007), _fp()])
+    assert "rank 1" in msg
+    assert "'seed'" in msg
+    assert "1007" in msg
+
+
+def test_diagnose_reports_most_upstream_field_first():
+    """A host with a different config digest AND a skewed seed is diagnosed
+    on 'config' — the causally upstream divergence."""
+    msg = guard.diagnose_fingerprints(
+        [_fp(), _fp(config="cfgX", seed=1007), _fp()]
+    )
+    assert "'config'" in msg and "'seed'" not in msg
+
+
+def test_diagnose_majority_wins_even_against_rank0():
+    msg = guard.diagnose_fingerprints([_fp(step=101), _fp(), _fp()])
+    assert "rank 0" in msg and "'step'" in msg
+
+
+def test_diagnose_two_host_tie_hedges_instead_of_guessing():
+    """With 2 hosts (or any even split) there is no majority: confidently
+    naming one side would send the operator to debug the wrong machine."""
+    msg = guard.diagnose_fingerprints([_fp(), _fp(seed=1007)])
+    assert "rank 1" in msg and "'seed'" in msg
+    assert "no majority" in msg
+    assert "1007" in msg and "7" in msg  # both values listed
+    assert "other rank(s) agree" not in msg  # no false confidence
+
+
+def test_chaos_configure_without_flag_disarms_stale_plan():
+    """In-process sweep drivers (--suppress-crashes) must not leak trial
+    1's fault plan into a later non-chaos trial."""
+    chaos.configure(Namespace(fault_inject="seed-skew@0@0"))
+    assert chaos.maybe_skew_seed(5, 7) == 1007
+    chaos.configure(Namespace())  # trial 2: no --fault-inject
+    assert chaos.maybe_skew_seed(5, 7) == 7
+
+
+def test_diagnose_foreign_payload_names_out_of_sync_rank():
+    """A rank whose gathered row is not a fingerprint at all is executing a
+    DIFFERENT collective — named as out of sync, not a raw type error."""
+    msg = guard.diagnose_fingerprints([_fp(), {"something": "else"}])
+    assert "rank 1" in msg and "out of sync" in msg
+
+
+def test_config_digest_ignores_per_host_fields():
+    a = Namespace(seed=1, lr=[1e-3], distributed_rank=0, device_id=0)
+    b = Namespace(seed=1, lr=[1e-3], distributed_rank=3, device_id=2)
+    c = Namespace(seed=2, lr=[1e-3], distributed_rank=0, device_id=0)
+    assert guard.config_digest(a) == guard.config_digest(b)
+    assert guard.config_digest(a) != guard.config_digest(c)
+
+
+def test_config_digest_ignores_host_local_io_paths():
+    """Per-host scratch dirs / logging sinks are legitimate and must not
+    trip a false 'config' divergence; math-relevant flags still count."""
+    a = Namespace(seed=1, batch_size=8, save_dir="/local/host0/ckpts",
+                  tmp_save_dir="/scratch0", tensorboard_logdir="/tb0",
+                  wandb_name="run-host0")
+    b = Namespace(seed=1, batch_size=8, save_dir="/local/host1/ckpts",
+                  tmp_save_dir="/scratch1", tensorboard_logdir="/tb1",
+                  wandb_name="run-host1")
+    c = Namespace(seed=1, batch_size=16, save_dir="/local/host0/ckpts",
+                  tmp_save_dir="/scratch0", tensorboard_logdir="/tb0",
+                  wandb_name="run-host0")
+    assert guard.config_digest(a) == guard.config_digest(b)
+    assert guard.config_digest(a) != guard.config_digest(c)
+
+
+def test_batch_signature_shapes_dtypes_and_narrowing():
+    assert guard.batch_signature(None) is None
+    assert guard.batch_signature({}) is None
+    assert guard.batch_signature({"x": np.float32(1.0)}) == "unshardable"
+    sig = guard.batch_signature({"t": np.zeros((4, 8), np.int64)})
+    _, leaves = sig
+    assert leaves == (((4, 8), "int32"),)  # post-narrowing dtype
+
+
+def test_fingerprint_reflects_chaos_seed_skew():
+    chaos.configure(Namespace(fault_inject="seed-skew@2@0"))
+    g = guard.ConsistencyGuard(
+        Namespace(consistency_check_interval=1, seed=7)
+    )
+
+    class Stub:
+        step = 2
+
+        def get_num_updates(self):
+            return self.step
+
+        def get_lr(self):
+            return 1e-3
+
+        def current_loss_scale(self):
+            return 1.0
+
+    fp = g.fingerprint(Stub())
+    assert fp["seed"] == 1007 and fp["step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_disabled_is_a_direct_call():
+    guard.configure(Namespace(collective_timeout=0))
+    assert guard.run_collective("all_reduce", lambda: 42) == 42
+
+
+def test_watchdog_propagates_worker_errors():
+    guard.configure(Namespace(collective_timeout=30))
+    with pytest.raises(ValueError, match="boom"):
+        guard.run_collective(
+            "all_gather_list", lambda: (_ for _ in ()).throw(ValueError("boom"))
+        )
+
+
+def test_watchdog_raises_with_thread_stacks_on_stall(caplog):
+    """Acceptance: a stalled collective raises through the watchdog with
+    thread stacks logged — naming the collective and the last-known step."""
+    guard.configure(Namespace(collective_timeout=0.5))
+    guard.note_step(123)
+    with caplog.at_level("ERROR"):
+        with pytest.raises(guard.CollectiveTimeoutError) as exc:
+            guard.run_collective("all_gather_list", lambda: time.sleep(10))
+    msg = str(exc.value)
+    assert "all_gather_list" in msg and "123" in msg
+    logged = "\n".join(r.message for r in caplog.records)
+    assert "thread stacks" in logged.lower()
+    assert "collective-all_gather_list" in logged  # the stalled worker thread
+    assert 'File "' in logged  # actual stack frames
+
+
+def test_watchdog_poisons_collective_plane_after_timeout():
+    """After a timeout the orphaned worker may complete the stalled
+    collective later; running another collective would pair mismatched
+    payloads across hosts — so the plane is poisoned (relevant for
+    --suppress-crashes sweep drivers that swallow the timeout)."""
+    guard.configure(Namespace(collective_timeout=0.4))
+    with pytest.raises(guard.CollectiveTimeoutError):
+        guard.run_collective("all_gather_list", lambda: time.sleep(8))
+    ran = []
+    with pytest.raises(guard.CollectiveTimeoutError, match="poisoned"):
+        guard.run_collective("broadcast_object", lambda: ran.append(1))
+    assert ran == []  # the refused collective never executed
+    guard.reset()  # a fresh process-equivalent state clears the poison
+    guard.configure(Namespace(collective_timeout=5))
+    assert guard.run_collective("all_reduce", lambda: 7) == 7
+
+
+def test_watchdog_reuses_one_persistent_worker_thread():
+    import threading
+
+    guard.configure(Namespace(collective_timeout=5))
+    idents = []
+    for _ in range(3):
+        guard.run_collective(
+            "all_reduce", lambda: idents.append(threading.get_ident())
+        )
+    assert len(idents) == 3 and len(set(idents)) == 1
+    assert idents[0] != threading.get_ident()  # ran off the main thread
+
+
+def test_chaos_collective_delay_trips_the_watchdog():
+    """The collective-delay kind stalls this rank inside the collective long
+    enough for its own watchdog budget to expire."""
+    chaos.configure(Namespace(fault_inject="collective-delay:5@0@0"))
+    guard.configure(Namespace(collective_timeout=0.4))
+    with pytest.raises(guard.CollectiveTimeoutError):
+        guard.run_collective("broadcast_object", lambda: "never-reached")
+
+
+def test_decode_gathered_rows_diagnoses_desynced_rank():
+    """The reference's trick: an undecodable all_gather_list payload means
+    that rank is out of sync — a named-rank DesyncError, not a raw
+    unpickle traceback."""
+    from unicore_tpu.distributed import utils as distributed_utils
+
+    def row(obj, pad=64):
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        buf = np.zeros(8 + pad, np.uint8)
+        buf[:8] = np.frombuffer(
+            np.asarray([len(payload)], np.uint64).tobytes(), np.uint8
+        )
+        buf[8 : 8 + len(payload)] = payload
+        return buf
+
+    good = row({"rank": 0})
+    garbage = np.full(72, 255, np.uint8)  # length header is absurd
+    with pytest.raises(guard.DesyncError, match="rank 1"):
+        distributed_utils._decode_gathered_rows([good, garbage])
+    out = distributed_utils._decode_gathered_rows([good, row({"rank": 1})])
+    assert out == [{"rank": 0}, {"rank": 1}]
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_requests_graceful_stop_and_second_sigint_aborts():
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    try:
+        assert guard.install_signal_handlers()
+        assert guard.stop_requested() is None
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)
+        assert guard.stop_requested() == "SIGTERM"
+        # the FIRST ^C after a manager-sent SIGTERM stays graceful (it must
+        # not kill the checkpoint the SIGTERM handler promised)
+        os.kill(os.getpid(), signal.SIGINT)
+        time.sleep(0.05)
+        assert guard.stop_requested() == "SIGINT"
+        # the second ^C means "abort NOW"
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+            time.sleep(0.2)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+
+
+def test_stop_requested_global_single_host_passthrough():
+    assert guard.stop_requested_global() is None
+    guard._handle_stop_signal(signal.SIGTERM, None)
+    assert guard.stop_requested_global() == "SIGTERM"
+
+
+def test_graceful_stop_reported_as_hard_stop_reason():
+    """The CLI session turns a pending stop signal into an ordinary stop
+    reason — save a checkpoint, exit 0 (no KeyboardInterrupt unwinding)."""
+    from unicore_tpu_cli.train import TrainSession
+
+    session = TrainSession.__new__(TrainSession)  # no trainer needed
+    session.args = Namespace(max_update=0, stop_time_hours=0)
+    session.trainer = None
+    guard._handle_stop_signal(signal.SIGTERM, None)
+    reason = TrainSession.hard_stop_reason(session)
+    assert reason is not None and "SIGTERM" in reason and "checkpoint" in reason
+
+
+# ---------------------------------------------------------------------------
+# data-pipeline stall watchdog (--data-stall-timeout)
+# ---------------------------------------------------------------------------
+
+
+def test_buffered_iterator_stall_escalates_with_context():
+    from unicore_tpu.data.iterators import BufferedIterator, DataStallError
+
+    class Wedged:
+        def __len__(self):
+            return 5
+
+        def __iter__(self):
+            yield {"batch": 1}
+            time.sleep(30)  # the producer wedges: nothing ever follows
+
+    it = BufferedIterator(
+        2, Wedged(), stall_timeout=0.5,
+        context="dataset FakeLMDBDataset, epoch 3, shard 0/2",
+    )
+    assert next(it) == {"batch": 1}
+    with pytest.raises(DataStallError) as exc:
+        next(it)
+    msg = str(exc.value)
+    assert "FakeLMDBDataset" in msg and "epoch 3" in msg
+    assert "1/5" in msg  # position: delivered/total
+    assert "alive but wedged" in msg
+
+
+def test_buffered_iterator_without_timeout_keeps_old_behavior():
+    from unicore_tpu.data.iterators import BufferedIterator
+
+    it = BufferedIterator(2, [1, 2, 3])
+    assert list(it) == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# 2-process integration: the guard names the skewed rank + field
+# ---------------------------------------------------------------------------
+
+_PREAMBLE = r"""
+import os, sys
+rank = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+# 2 virtual devices per host: the CPU backend refuses true multiprocess
+# computations on single-device hosts (same setup as test_multihost)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:  # the default CPU client refuses cross-process computations
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+_cache = os.environ.get(
+    "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_test_jaxcache"
+)
+if _cache != "0":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n, process_id=rank)
+sys.path.insert(0, "__REPO__")
+
+from argparse import Namespace
+from unicore_tpu.distributed import chaos, guard
+from unicore_tpu.distributed import utils as du
+
+
+class Stub:
+    step = 1
+
+    def get_num_updates(self):
+        return self.step
+
+    def get_lr(self):
+        return 1e-3
+
+    def current_loss_scale(self):
+        return 1.0
+"""
+
+
+SKEW_WORKER = _PREAMBLE + r"""
+import numpy as np
+
+# --- phase 1: seed-skew on rank 1 from step 2 (step 1 must pass clean) ----
+args = Namespace(seed=7, consistency_check_interval=1,
+                 fault_inject="seed-skew@2@1", collective_timeout=120.0)
+guard.configure(args)
+chaos.configure(args)
+g = guard.ConsistencyGuard(args)
+stub = Stub()
+
+g.maybe_check(stub)
+print(f"RANK{rank}_CLEAN_AT_STEP1", flush=True)
+
+stub.step = 2
+try:
+    g.maybe_check(stub)
+    print(f"RANK{rank}_SEED_GUARD_DID_NOT_FIRE", flush=True)
+except guard.ConsistencyError as e:
+    print(f"RANK{rank}_SEED_GUARD_FIRED {e}", flush=True)
+
+# --- phase 2: geometry-skew on rank 1 (same cluster, fresh plan) ----------
+chaos.reset()
+chaos.configure(Namespace(fault_inject="geometry-skew@3@1"))
+stub.step = 3
+batch = {"net_input": {"src_tokens": np.zeros((4, 16), np.int64)},
+         "target": np.zeros((4, 16), np.int64)}
+samples = chaos.maybe_perturb_geometry(stub.step, [batch])
+g.note_batch_sigs([guard.batch_signature(s) for s in samples])
+try:
+    g.maybe_check(stub)
+    print(f"RANK{rank}_GEOM_GUARD_DID_NOT_FIRE", flush=True)
+except guard.ConsistencyError as e:
+    print(f"RANK{rank}_GEOM_GUARD_FIRED {e}", flush=True)
+import os as _os
+_os._exit(0)
+"""
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+def _spawn_two(worker_src):
+    port = _free_port()
+    return [
+        subprocess.Popen(
+            [sys.executable, "-c", worker_src.replace("__REPO__", REPO),
+             str(r), "2", port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+
+
+def _drain(procs, timeout=240):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    return outs
+
+
+def test_two_process_seed_skew_and_geometry_skew_name_rank_and_field():
+    """Acceptance: injecting a seed skew (then a geometry skew) on rank 1
+    fails fast on BOTH hosts with a diagnosis naming rank 1 and the
+    divergent field — not a hang, not a raw unpickle traceback.  One
+    cluster spawn covers both kinds to keep tier-1 wall-clock down."""
+    outs = _drain(_spawn_two(SKEW_WORKER))
+    for r, out in enumerate(outs):
+        assert f"RANK{r}_CLEAN_AT_STEP1" in out, f"rank {r}:\n{out[-5000:]}"
+        assert f"RANK{r}_SEED_GUARD_FIRED" in out, f"rank {r}:\n{out[-5000:]}"
+        assert "rank 1" in out and "'seed'" in out, out[-5000:]
+        assert "1007" in out  # the skewed derivation, named in the diagnosis
+        assert f"RANK{r}_GEOM_GUARD_FIRED" in out, f"rank {r}:\n{out[-5000:]}"
+        assert "'batch_sig'" in out, out[-5000:]
+    # surfaced for the CI chaos smoke step's grep (run with pytest -s)
+    print("\nCHAOS-DIAGNOSIS:", outs[0].split("SEED_GUARD_FIRED", 1)[1][:400])
+
+
+FALLBACK_WORKER = _PREAMBLE + r"""
+import time
+import numpy as np
+from unicore_tpu import checkpoint_utils
+
+# per-RANK save dirs: the torn file exists on rank 1 only, so without the
+# collective agreement rank 0 would happily resume from checkpoint_last
+# while rank 1 falls back — a divergent resume
+save_dir = f"/tmp/unicore_guard_fb_{port}_{rank}"
+os.makedirs(save_dir, exist_ok=True)
+
+
+def write(name, epoch):
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.full((8,), float(epoch))},
+         "extra_state": {"epoch": epoch}},
+        os.path.join(save_dir, name),
+    )
+    time.sleep(0.05)
+
+
+write("checkpoint_1_100.pt", 1)
+write("checkpoint_1_200.pt", 2)
+write("checkpoint_last.pt", 3)
+if rank == 1:
+    p = os.path.join(save_dir, "checkpoint_last.pt")
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+
+
+class StubTrainer:
+    checkpoint_suffix = ""
+    loaded_path = None
+
+    def load_checkpoint(self, path, *a, **k):
+        if not os.path.exists(path):
+            return None
+        state = checkpoint_utils.load_checkpoint_to_cpu(path)
+        self.loaded_path = path
+        return state.get("extra_state")
+
+
+args = Namespace(save_dir=save_dir, restore_file="checkpoint_last.pt",
+                 finetune_from_model=None, optimizer_overrides="{}",
+                 reset_optimizer=False, reset_lr_scheduler=False,
+                 reset_meters=False, reset_dataloader=False)
+tr = StubTrainer()
+extra = checkpoint_utils.load_checkpoint(args, tr)
+print(f"RANK{rank}_LOADED {os.path.basename(tr.loaded_path)} "
+      f"epoch={extra['epoch']}", flush=True)
+import os as _os
+_os._exit(0)
+"""
+
+
+def test_two_process_corrupt_fallback_stays_in_lockstep():
+    """Code-review finding: a checkpoint torn on ONE host must drag EVERY
+    host to the same agreed fallback — never a divergent resume where rank
+    0 keeps checkpoint_last while rank 1 silently rewinds."""
+    outs = _drain(_spawn_two(FALLBACK_WORKER))
+    for r, out in enumerate(outs):
+        assert f"RANK{r}_LOADED checkpoint_1_200.pt epoch=2" in out, (
+            f"rank {r}:\n{out[-5000:]}"
+        )
+
+
+WATCHDOG_STALL_WORKER = _PREAMBLE + r"""
+import os as _os
+
+if rank == 0:
+    # generous-enough budget for cluster startup, far shorter than the
+    # peer's injected 120s stall
+    args = Namespace(seed=7, collective_timeout=8.0)
+    guard.configure(args)
+    try:
+        du.all_gather_list({"rank": rank})
+        print("RANK0_NO_TIMEOUT", flush=True)
+    except guard.CollectiveTimeoutError as e:
+        print(f"RANK0_WATCHDOG_FIRED {e}", flush=True)
+    _os._exit(0)
+else:
+    # rank 1 never enters the collective in time: the chaos delay holds it
+    args = Namespace(seed=7, collective_timeout=0.0,
+                     fault_inject="collective-delay:120@0@1")
+    guard.configure(args)
+    chaos.configure(args)
+    try:
+        du.all_gather_list({"rank": rank})
+    except BaseException:
+        pass
+    _os._exit(0)
+"""
+
+
+def test_two_process_stalled_collective_raises_through_watchdog():
+    """Companion acceptance test: rank 1 stalls inside the collective; rank
+    0's watchdog converts the hang into a CollectiveTimeoutError naming the
+    collective, with thread stacks logged."""
+    procs = _spawn_two(WATCHDOG_STALL_WORKER)
+    try:
+        out0, _ = procs[0].communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        out0, _ = procs[0].communicate()
+    finally:
+        procs[1].kill()
+        procs[1].communicate()
+    assert "RANK0_WATCHDOG_FIRED" in out0, out0[-5000:]
+    assert "all_gather_list" in out0
+    assert "thread stacks" in out0.lower()  # the logged dump
+    assert 'File "' in out0  # real stack frames in the dump

@@ -502,6 +502,128 @@ def test_dead_flag_negative_read_variants(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# untimed-collective
+# ---------------------------------------------------------------------------
+
+
+def test_untimed_collective_module_attribute_calls(tmp_path):
+    """Raw multihost_utils collectives outside distributed/utils.py are
+    flagged — they have no watchdog timeout, so a desynced peer hangs them
+    forever (positive fixture 1)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        from jax.experimental import multihost_utils
+
+        def gather_stats(arr):
+            return multihost_utils.process_allgather(arr)
+
+        def checkpoint_barrier():
+            multihost_utils.sync_global_devices("pre_save")
+        """,
+        select=["untimed-collective"],
+    )
+    assert rule_names(vs) == ["untimed-collective"] * 2
+    assert "process_allgather" in vs[0].message
+    assert "watchdog" in vs[0].message
+
+
+def test_untimed_collective_member_import_and_alias(tmp_path):
+    """Members imported straight off multihost_utils (with or without an
+    alias) are still caught (positive fixture 2)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        from jax.experimental.multihost_utils import broadcast_one_to_all as b1a
+
+        def push_config(buf, is_source):
+            return b1a(buf, is_source=is_source)
+        """,
+        select=["untimed-collective"],
+    )
+    assert rule_names(vs) == ["untimed-collective"]
+    assert "b1a" in vs[0].message
+
+
+def test_untimed_collective_negative_wrappers_and_lookalikes(tmp_path):
+    """The timed wrappers are the sanctioned path, and a local function that
+    merely SHARES a collective's name (no multihost_utils import) is not a
+    collective (negative fixture)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        from unicore_tpu.distributed import utils as distributed_utils
+
+        def process_allgather(xs):
+            return list(xs)  # local helper, not jax's
+
+        def gather(data):
+            stats = process_allgather([data])
+            return distributed_utils.all_gather_list(stats)
+        """,
+        select=["untimed-collective"],
+    )
+    assert vs == []
+
+
+def test_untimed_collective_home_module_exempt(tmp_path):
+    """distributed/utils.py itself must touch the raw collectives — that is
+    where the watchdog wrappers live."""
+    home = tmp_path / "distributed"
+    home.mkdir()
+    import textwrap as _tw
+
+    (home / "utils.py").write_text(
+        _tw.dedent(
+            """
+            from jax.experimental import multihost_utils
+
+            def all_gather_list(data):
+                return multihost_utils.process_allgather(data)
+            """
+        )
+    )
+    vs = lint_paths([str(home)], rules=build_rules(["untimed-collective"]))
+    assert vs == []
+
+
+def test_untimed_collective_lookalike_path_not_exempt(tmp_path):
+    """The home exemption is a path-COMPONENT match: 'foodistributed/'
+    must not ride it."""
+    import textwrap as _tw
+
+    home = tmp_path / "foodistributed"
+    home.mkdir()
+    (home / "utils.py").write_text(
+        _tw.dedent(
+            """
+            from jax.experimental import multihost_utils
+
+            def gather(data):
+                return multihost_utils.process_allgather(data)
+            """
+        )
+    )
+    vs = lint_paths([str(home)], rules=build_rules(["untimed-collective"]))
+    assert rule_names(vs) == ["untimed-collective"]
+
+
+def test_untimed_collective_suppression_comment(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        from jax.experimental import multihost_utils
+
+        def startup_probe(x):
+            # lint: untimed-collective
+            return multihost_utils.process_allgather(x)
+        """,
+        select=["untimed-collective"],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + registry + CLI + the tree itself
 # ---------------------------------------------------------------------------
 
@@ -560,13 +682,14 @@ def test_parse_error_reported(tmp_path):
 
 
 def test_seeded_violations_of_every_rule(tmp_path):
-    """Acceptance: one fixture seeding all six rules at once — each is
+    """Acceptance: one fixture seeding all seven rules at once — each is
     detected by the full default rule set."""
     vs = run_lint(
         tmp_path,
         """
         import jax
         import numpy as np
+        from jax.experimental import multihost_utils
 
         def add_args(parser):
             parser.add_argument("--never-read", type=int)
@@ -579,6 +702,9 @@ def test_seeded_violations_of_every_rule(tmp_path):
             a = jax.random.normal(key, (4,))
             b = jax.random.uniform(key, (4,))         # prng-key-reuse
             return float(x) + a + b + noise           # host-sync-in-jit
+
+        def gather(stats):
+            return multihost_utils.process_allgather(stats)  # untimed-collective
 
         def run(mesh, f, x):
             return jax.shard_map(f, mesh=mesh, in_specs=(None,),
@@ -593,6 +719,7 @@ def test_seeded_violations_of_every_rule(tmp_path):
         "prng-key-reuse",
         "unsafe-shard-map",
         "dead-flag",
+        "untimed-collective",
     }
 
 

@@ -22,6 +22,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=__NDEV__"
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # the default CPU client refuses cross-process computations
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 _cache = os.environ.get(
     "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_test_jaxcache"
 )

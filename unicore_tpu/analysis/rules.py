@@ -6,6 +6,7 @@ examples, and the justification-comment escape hatches.
 """
 
 import ast
+import os
 from typing import Iterator, Optional, Set
 
 from unicore_tpu.analysis.core import (
@@ -702,3 +703,96 @@ class PrngKeyReuse(LintRule):
             if kw.arg == "key" and isinstance(kw.value, ast.Name):
                 return kw.value.id
         return None
+
+
+# ---------------------------------------------------------------------------
+# 6. untimed-collective
+# ---------------------------------------------------------------------------
+
+# the raw jax.experimental.multihost_utils entry points every host-side
+# control-plane collective bottoms out in
+_RAW_COLLECTIVES = frozenset(
+    {"process_allgather", "broadcast_one_to_all", "sync_global_devices"}
+)
+
+# the one module allowed to touch them: its wrappers run each collective
+# under the watchdog (guard.run_collective) and decode peer payloads with
+# a desync diagnosis
+_COLLECTIVE_HOME = os.path.join("distributed", "utils.py")
+
+
+@register_lint_rule("untimed-collective")
+class UntimedCollective(LintRule):
+    name = "untimed-collective"
+    description = (
+        "direct call to a raw host-side collective "
+        "(jax.experimental.multihost_utils) outside distributed/utils.py's "
+        "watchdog-timed wrappers — a desynced or preempted peer hangs it "
+        "forever with no diagnosis; route through "
+        "unicore_tpu.distributed.utils (all_gather_list, broadcast_object, "
+        "barrier, ...)"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        norm = os.path.normpath(module.path)
+        # exact path-component match: 'foodistributed/utils.py' must NOT
+        # ride the exemption
+        if norm == _COLLECTIVE_HOME or norm.endswith(
+            os.sep + _COLLECTIVE_HOME
+        ):
+            return
+        mod_aliases, member_aliases = self._multihost_aliases(module.tree)
+        if not mod_aliases and not member_aliases:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = None
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _RAW_COLLECTIVES
+                and terminal_name(func.value) in mod_aliases
+            ):
+                hit = f"{terminal_name(func.value)}.{func.attr}"
+            elif (
+                isinstance(func, ast.Name)
+                and func.id in member_aliases
+                and member_aliases[func.id] in _RAW_COLLECTIVES
+            ):
+                hit = func.id
+            if hit:
+                yield _v(
+                    self,
+                    module,
+                    node,
+                    f"raw host collective {hit}(...) outside "
+                    "distributed/utils.py: it has no watchdog timeout, so a "
+                    "desynced/preempted peer hangs it forever with no "
+                    "diagnosis — use the timed wrapper in "
+                    "unicore_tpu.distributed.utils instead",
+                )
+
+    @staticmethod
+    def _multihost_aliases(tree):
+        """Local names bound to the multihost_utils module, and local names
+        of members imported straight off it (name -> original member)."""
+        mod_aliases: Set[str] = set()
+        member_aliases = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.experimental.multihost_utils":
+                        # `import jax.experimental.multihost_utils` binds
+                        # `jax`; calls then go through the dotted attribute
+                        # chain whose terminal base is `multihost_utils`
+                        mod_aliases.add(a.asname or "multihost_utils")
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == "jax.experimental":
+                    for a in node.names:
+                        if a.name == "multihost_utils":
+                            mod_aliases.add(a.asname or a.name)
+                elif node.module == "jax.experimental.multihost_utils":
+                    for a in node.names:
+                        member_aliases[a.asname or a.name] = a.name
+        return mod_aliases, member_aliases

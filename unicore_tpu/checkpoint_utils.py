@@ -305,19 +305,169 @@ def _resolve_restore(args, suffix):
     return path, resets
 
 
-def load_checkpoint(args, trainer, **passthrough_args):
-    """Load a checkpoint and restore the training iterator."""
-    path, resets = _resolve_restore(args, trainer.checkpoint_suffix)
+class CorruptCheckpointError(RuntimeError):
+    """The checkpoint FILE could not be read or decoded — torn write, bit
+    rot, or failing storage.  Raised by :func:`load_checkpoint_to_cpu` for
+    ANY parse/read failure (bit-flipped pickles throw OverflowError,
+    ValueError, AttributeError, ... — an open set no tuple can cover), so
+    the resume fallback keys on the file layer, while genuine operator
+    errors AFTER a successful parse (shape mismatches in merge_params,
+    unknown optimizers) still crash loudly with their own types."""
 
-    extra_state = trainer.load_checkpoint(
-        path,
-        resets["optimizer"],
-        resets["lr_scheduler"],
-        resets["dataloader"],
-        ast.literal_eval(args.optimizer_overrides),
-        reset_meters=resets["meters"],
-        **passthrough_args,
+
+# What a damaged checkpoint raises to load_checkpoint's fallback loop:
+# the parse-layer wrapper above, plus read-I/O failures (EIO, stale NFS
+# handles) from paths that bypass load_checkpoint_to_cpu (orbax restores).
+CORRUPT_CHECKPOINT_ERRORS = (CorruptCheckpointError, OSError)
+
+
+def _fallback_checkpoints(save_dir, suffix):
+    """Retained checkpoints in ``save_dir`` eligible as resume fallbacks,
+    newest first by mtime."""
+    suffix_re = re.escape(suffix or "")
+    patterns = (
+        rf"checkpoint_\d+_(\d+){suffix_re}\.pt",   # --save-interval-updates
+        rf"checkpoint(\d+){suffix_re}\.pt",        # epoch checkpoints
+        rf"checkpoint_best{suffix_re}\.pt",
     )
+    candidates = []
+    seen = set()
+    for pattern in patterns:
+        for p in checkpoint_paths(save_dir, pattern=pattern):
+            ap = os.path.abspath(p)
+            if ap not in seen:
+                seen.add(ap)
+                candidates.append(p)
+    candidates.sort(key=os.path.getmtime, reverse=True)
+    return candidates
+
+
+def _gather_load_outcomes(outcome: str):
+    """Multi-host: every rank reports its load outcome ("loaded" /
+    "missing" / "corrupt").  A torn OR locally-missing file on ONE host
+    (per-shard suffixes, per-host save dirs) must force EVERY host to the
+    same fallback, or hosts silently resume from different states — a
+    rank fresh-initializing while its peers load a checkpoint is just as
+    divergent as a corrupt one."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [outcome]
+    from unicore_tpu.distributed import utils as distributed_utils
+
+    return distributed_utils.all_gather_list(outcome, max_size=1024)
+
+
+def _agree_fallback_name(basename):
+    """Multi-host: rank 0's fallback choice (a basename under save_dir)
+    binds every rank, so the retry stays in lockstep."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return basename
+    from unicore_tpu.distributed import utils as distributed_utils
+
+    return distributed_utils.broadcast_object(basename)
+
+
+def load_checkpoint(args, trainer, **passthrough_args):
+    """Load a checkpoint and restore the training iterator.
+
+    A corrupt/truncated resume checkpoint (torn write that survived a
+    crash, chaos ``truncate-checkpoint``) falls back to the next-newest
+    retained checkpoint from :func:`checkpoint_paths` with a loud warning
+    instead of crashing — losing a few hundred updates beats losing the
+    run.  On multi-host, the load outcome is agreed collectively and rank
+    0's fallback choice binds all ranks, so a file torn on one host can
+    never leave hosts resuming from different checkpoints.  Finetune
+    starts never fall back (a retained checkpoint of a DIFFERENT run is
+    not a substitute for the pretrained model), and neither does an
+    explicit non-default ``--restore-file`` — silently substituting a
+    retained checkpoint for a file the operator named would resume from a
+    state they never chose.  A finetune run RESUMING from its own
+    ``checkpoint_last`` does fall back: the retained checkpoints are this
+    run's."""
+    path, resets = _resolve_restore(args, trainer.checkpoint_suffix)
+    # fallback only when resuming the implicit checkpoint_last — exactly
+    # the case where the retained files in save_dir belong to this run
+    allow_fallback = path == os.path.join(
+        args.save_dir, f"checkpoint_last{trainer.checkpoint_suffix}.pt"
+    )
+
+    tried = set()  # basenames attempted (identical across ranks)
+    current = path
+    while True:
+        err = None
+        extra_state = None
+        exists = os.path.exists(current)
+        try:
+            extra_state = trainer.load_checkpoint(
+                current,
+                resets["optimizer"],
+                resets["lr_scheduler"],
+                resets["dataloader"],
+                ast.literal_eval(args.optimizer_overrides),
+                reset_meters=resets["meters"],
+                **passthrough_args,
+            )
+        except CORRUPT_CHECKPOINT_ERRORS as e:
+            err = e
+        outcome = (
+            "corrupt" if err is not None else ("loaded" if exists else "missing")
+        )
+        outcomes = _gather_load_outcomes(outcome)
+        # all-loaded is a resume; all-missing is a legitimate fresh start.
+        # ANY mix (corrupt anywhere, or a file present on some hosts but
+        # not others) forces the whole cluster to the next fallback.
+        if all(o == "loaded" for o in outcomes) or all(
+            o == "missing" for o in outcomes
+        ):
+            break
+        tried.add(os.path.basename(current))
+        candidates = (
+            [
+                p
+                for p in _fallback_checkpoints(
+                    args.save_dir, trainer.checkpoint_suffix
+                )
+                if os.path.basename(p) not in tried
+            ]
+            if allow_fallback
+            else []
+        )
+        choice = _agree_fallback_name(
+            os.path.basename(candidates[0]) if candidates else None
+        )
+        if choice is None:
+            detail = (
+                f"({type(err).__name__}: {err})"
+                if err is not None
+                else "(a peer host reported the corruption)"
+            )
+            logger.error(
+                f"checkpoint {current} is corrupt/truncated {detail} and "
+                f"no retained fallback checkpoint exists in {args.save_dir}"
+            )
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                "a peer host hit a corrupt/truncated/missing checkpoint "
+                "and no retained fallback exists; aborting to avoid a "
+                "divergent resume"
+            )
+        nxt = os.path.join(args.save_dir, choice)
+        if err is not None:
+            detail = f"failed to load ({type(err).__name__}: {err})"
+        elif outcome == "missing":
+            detail = "is missing on this host while peers have a checkpoint"
+        else:
+            detail = "was reported corrupt/missing by a peer host"
+        logger.warning(
+            f"CHECKPOINT CORRUPT: {current} {detail}; falling back to the "
+            f"next-newest retained checkpoint {nxt} — training resumes "
+            "from an OLDER state than the torn file recorded"
+        )
+        current = nxt
     if extra_state is None:
         return None
 
@@ -336,13 +486,25 @@ def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
     """
     import sys
 
-    if detect_checkpoint_format(path) == "torch":
-        try:
-            state = load_torch_checkpoint(path)
-        except Exception as torch_err:
-            # mis-sniff in the opposite direction (a native pickle whose
-            # header imitated a torch magic): give pickle one chance, and
-            # surface the ORIGINAL torch error if both fail
+    try:
+        if detect_checkpoint_format(path) == "torch":
+            try:
+                state = load_torch_checkpoint(path)
+            except Exception as torch_err:
+                # mis-sniff in the opposite direction (a native pickle whose
+                # header imitated a torch magic): give pickle one chance, and
+                # surface the ORIGINAL torch error if both fail
+                try:
+                    with open(path, "rb") as f:
+                        state = pickle.load(f)
+                    if not isinstance(state, dict):
+                        raise ValueError(
+                            f"not a checkpoint dict: {type(state).__name__}"
+                        )
+                except Exception:
+                    raise torch_err from None
+        else:
+            torch_was_loaded = "torch" in sys.modules
             try:
                 with open(path, "rb") as f:
                     state = pickle.load(f)
@@ -350,38 +512,37 @@ def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
                     raise ValueError(
                         f"not a checkpoint dict: {type(state).__name__}"
                     )
-            except Exception:
-                raise torch_err from None
-    else:
-        torch_was_loaded = "torch" in sys.modules
-        try:
-            with open(path, "rb") as f:
-                state = pickle.load(f)
-            if not isinstance(state, dict):
-                raise ValueError(
-                    f"not a checkpoint dict: {type(state).__name__}"
-                )
-        except Exception as pickle_err:
-            # mis-sniffed torch file (e.g. legacy stream written with a
-            # non-default pickle protocol): give torch.load one chance, but
-            # if that fails too, surface the ORIGINAL pickle error — a
-            # corrupt native checkpoint must not masquerade as a torch
-            # problem (or as "torch missing" on torch-less hosts)
-            try:
-                state = load_torch_checkpoint(path)
-            except Exception:
-                raise pickle_err from None
-        else:
-            # A dict pickled with torch tensors inside (plain-pickled torch
-            # state) still needs the numpy conversion.  Unpickling such
-            # tensors imports torch, so torch newly appearing in
-            # sys.modules proves they exist; if torch was already imported
-            # for unrelated reasons, scan for actual tensor leaves rather
-            # than rebuilding every native checkpoint's tree.
-            if "torch" in sys.modules and (
-                not torch_was_loaded or _has_torch_tensors(state)
-            ):
-                state = torch_to_pytree(state)
+            except Exception as pickle_err:
+                # mis-sniffed torch file (e.g. legacy stream written with a
+                # non-default pickle protocol): give torch.load one chance,
+                # but if that fails too, surface the ORIGINAL pickle error —
+                # a corrupt native checkpoint must not masquerade as a torch
+                # problem (or as "torch missing" on torch-less hosts)
+                try:
+                    state = load_torch_checkpoint(path)
+                except Exception:
+                    raise pickle_err from None
+            else:
+                # A dict pickled with torch tensors inside (plain-pickled
+                # torch state) still needs the numpy conversion.  Unpickling
+                # such tensors imports torch, so torch newly appearing in
+                # sys.modules proves they exist; if torch was already
+                # imported for unrelated reasons, scan for actual tensor
+                # leaves rather than rebuilding every native checkpoint's
+                # tree.
+                if "torch" in sys.modules and (
+                    not torch_was_loaded or _has_torch_tensors(state)
+                ):
+                    state = torch_to_pytree(state)
+    except Exception as e:
+        # ANY read/parse failure is file damage as far as callers are
+        # concerned — bit-flipped pickles throw an open set of types
+        # (OverflowError, ValueError, AttributeError, UnicodeDecodeError,
+        # ...) that no error tuple can enumerate
+        raise CorruptCheckpointError(
+            f"could not read/decode checkpoint {path} "
+            f"({type(e).__name__}: {e})"
+        ) from e
 
     if "args" in state and state["args"] is not None and arg_overrides is not None:
         args = state["args"]
@@ -532,22 +693,37 @@ def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):
     return [os.path.join(path, name) for _, name in hits]
 
 
-def persistent_save(obj, filename, attempts=3):
+def persistent_save(obj, filename, attempts=3, backoff=0.5):
     """Atomic pickle save — write to a sibling tmp name, then rename over
     the target so readers never see a torn file.  Transient filesystem
-    errors (e.g. NFS blips) get a couple of retries; the last failure is
-    logged rather than raised, matching the reference's fire-and-forget
-    save semantics (torch_persistent_save)."""
+    errors (e.g. NFS blips) get retries with exponential backoff
+    (``backoff * 2**attempt`` seconds between tries — an NFS blip that
+    survives an immediate retry usually clears within seconds); the last
+    failure is logged rather than raised, matching the reference's
+    fire-and-forget save semantics (torch_persistent_save)."""
+    from unicore_tpu.distributed import chaos
+
     scratch = filename + ".tmp"
-    for remaining in reversed(range(attempts)):
+    for attempt in range(attempts):
         try:
             with open(scratch, "wb") as f:
                 pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
             os.rename(scratch, filename)
+            # chaos truncate-checkpoint: simulate a torn write that slipped
+            # past the atomic rename (pairs with the resume fallback)
+            chaos.maybe_truncate_checkpoint(filename)
             return
         except Exception:
-            if remaining == 0:
+            if attempt == attempts - 1:
                 logger.error(traceback.format_exc())
+                return
+            delay = backoff * (2 ** attempt)
+            logger.warning(
+                f"checkpoint write to {filename} failed (attempt "
+                f"{attempt + 1}/{attempts}); retrying in {delay:.1f}s:\n"
+                + traceback.format_exc(limit=2)
+            )
+            time.sleep(delay)
 
 
 def verify_checkpoint_directory(save_dir: str) -> None:

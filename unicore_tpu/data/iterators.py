@@ -138,6 +138,7 @@ class EpochBatchIterator(EpochBatchIterating):
         buffer_size=0,
         timeout=0,
         disable_shuffling=False,
+        stall_timeout=0.0,
     ):
         self.dataset = dataset
         self.collate_fn = collate_fn
@@ -153,6 +154,7 @@ class EpochBatchIterator(EpochBatchIterating):
         self.buffer_size = min(buffer_size, 20)
         self.timeout = timeout
         self.disable_shuffling = disable_shuffling
+        self.stall_timeout = stall_timeout
 
         self.epoch = max(epoch, 1)  # epochs are 1-based
         self.shuffle = not disable_shuffling
@@ -318,7 +320,15 @@ class EpochBatchIterator(EpochBatchIterating):
             num_workers=self.num_workers,
         )
         if self.buffer_size > 0:
-            itr = BufferedIterator(self.buffer_size, itr)
+            itr = BufferedIterator(
+                self.buffer_size,
+                itr,
+                stall_timeout=self.stall_timeout,
+                context=(
+                    f"dataset {type(self.dataset).__name__}, epoch {epoch}, "
+                    f"shard {self.shard_id}/{self.num_shards}"
+                ),
+            )
         return CountingIterator(itr, start=offset, total=len(shard))
 
 
@@ -411,6 +421,12 @@ class ShardedIterator(CountingIterator):
         )
 
 
+class DataStallError(RuntimeError):
+    """The prefetch producer delivered nothing for ``--data-stall-timeout``
+    seconds — the data pipeline is wedged (dead filesystem mount, deadlocked
+    loader, unreachable remote store), not merely slow."""
+
+
 class BufferedIterator(object):
     """Producer-thread prefetch of up to ``size`` ready batches.
 
@@ -419,18 +435,27 @@ class BufferedIterator(object):
     after the first 5 minutes of a run — when the buffer runs near empty,
     which indicates the data pipeline can't keep up with the device
     (reference iterators.py:471-554's bottleneck warning).
+
+    ``stall_timeout`` (seconds, 0 = off; ``--data-stall-timeout``)
+    escalates starvation into a diagnosis: when the producer delivers
+    NOTHING for that long, ``__next__`` raises :class:`DataStallError`
+    naming the dataset/epoch ``context`` and the position instead of
+    warning forever while the run silently makes no progress.
     """
 
     _RUNTIME_BEFORE_WARN = 5 * 60
     _WARN_EVERY = 15 * 60
 
-    def __init__(self, size, iterable):
+    def __init__(self, size, iterable, stall_timeout=0.0, context=None):
         self._queue = queue.Queue(size)
         self._iterable = iterable
         self._producer = None
         self._exhausted = False
         self._started = time.time()
         self._last_warn = None
+        self._stall_timeout = float(stall_timeout or 0.0)
+        self._context = context
+        self._delivered = 0
         self.total = len(iterable)
 
     def _start_producer(self):
@@ -478,6 +503,32 @@ class BufferedIterator(object):
         )
         self._last_warn = now
 
+    def _get_with_stall_watchdog(self):
+        """Block for the next item, but never past ``stall_timeout`` of
+        total producer silence."""
+        deadline = time.time() + self._stall_timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                where = f" of {self._context}" if self._context else ""
+                alive = (
+                    self._producer is not None and self._producer.is_alive()
+                )
+                raise DataStallError(
+                    f"data pipeline stalled: the prefetch producer delivered "
+                    f"nothing for {self._stall_timeout:.0f}s "
+                    f"(--data-stall-timeout) at position "
+                    f"{self._delivered}/{self.total}{where}; producer thread "
+                    f"{'is still alive but wedged' if alive else 'has DIED'}."
+                    "  Check the dataset storage (mount, LMDB file, remote "
+                    "store) — a merely-slow pipeline logs the starvation "
+                    "warning instead of tripping this."
+                )
+            try:
+                return self._queue.get(True, timeout=min(5.0, remaining))
+            except queue.Empty:
+                continue
+
     def __next__(self):
         # exhaustion must be sticky: a grouped/sliced consumer pulls once
         # more after the final partial chunk, and blocking on the drained
@@ -487,10 +538,14 @@ class BufferedIterator(object):
         if self._producer is None:
             self._start_producer()
         self._maybe_warn_starved()
-        item = self._queue.get(True)
+        if self._stall_timeout > 0:
+            item = self._get_with_stall_watchdog()
+        else:
+            item = self._queue.get(True)
         if isinstance(item, Exception):
             raise item
         if item is _DONE:
             self._exhausted = True
             raise StopIteration()
+        self._delivered += 1
         return item

@@ -1,1 +1,1 @@
-from . import utils  # noqa
+from . import chaos, guard, utils  # noqa

@@ -1,0 +1,231 @@
+"""Fault-injection harness (``--fault-inject KIND[:PARAM]@STEP[@RANK]``).
+
+The robustness subsystem (guard.py) exists to catch host desyncs, stalled
+collectives, and torn checkpoints — failure modes that never occur in a
+healthy test run.  This module manufactures them on demand so the
+multi-process tests (tests/test_guard.py) and the CI chaos smoke step can
+prove each guard actually fires with the right diagnosis, not just that
+the happy path stays green.
+
+Kinds (all persistent from STEP onward unless noted):
+
+``seed-skew``
+    The targeted rank derives its step rng from ``seed + 1000`` — the
+    host-fed scalar desync the consistency guard's ``seed`` field catches.
+``geometry-skew``
+    The targeted rank drops the last row of its local batch, so its
+    batch-geometry signature (and the collectively agreed slot plan)
+    diverges from its peers'.
+``collective-delay[:SECONDS]``
+    The targeted rank sleeps (default 30s) before entering each host
+    collective, stalling its peers inside theirs — what the collective
+    watchdog turns from an infinite hang into a diagnosed abort.
+``truncate-checkpoint``
+    Checkpoint files written by the targeted rank are truncated to half
+    after the atomic rename — the torn-file case the resume fallback
+    (checkpoint_utils.load_checkpoint) must survive.
+``raise``
+    Raises :class:`ChaosError` out of ``train_step`` at exactly STEP
+    (one-shot), exercising crash paths (--suppress-crashes, sweep drivers).
+
+RANK defaults to the LAST process (rank ``process_count - 1``): on a
+2-host cluster the fault lands on rank 1 while rank 0 — coordinator and
+checkpoint writer — stays healthy to report the diagnosis; single-host
+runs target rank 0 so every kind stays testable without a cluster.
+Exception: ``truncate-checkpoint`` defaults to rank 0, the only rank that
+writes checkpoints — targeting the last rank would be a silent no-op on
+multi-host runs.
+
+A fault plan is process-global (``configure(args)``); ``reset()`` clears
+it (tests).  With no ``--fault-inject`` every hook is a cheap no-op.
+"""
+
+import logging
+import time
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+KINDS = (
+    "seed-skew",
+    "geometry-skew",
+    "collective-delay",
+    "truncate-checkpoint",
+    "raise",
+)
+
+_SEED_SKEW_OFFSET = 1000
+_DEFAULT_DELAY_SECONDS = 30.0
+
+
+class ChaosError(RuntimeError):
+    """The injected mid-update failure (``raise`` kind)."""
+
+
+class FaultPlan:
+    """One parsed ``KIND[:PARAM]@STEP[@RANK]`` spec."""
+
+    def __init__(self, kind: str, step: int, rank: Optional[int] = None,
+                 param: Optional[float] = None):
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind '{kind}' (choose from {', '.join(KINDS)})"
+            )
+        self.kind = kind
+        self.step = step
+        self._rank = rank  # None = resolve to last rank at trigger time
+        self.param = param
+
+    @property
+    def rank(self) -> int:
+        if self._rank is not None:
+            return self._rank
+        if self.kind == "truncate-checkpoint":
+            # checkpoints are written by rank 0 (is_data_parallel_master);
+            # defaulting to the last rank would make this kind a silent
+            # no-op on multi-host runs
+            return 0
+        import jax
+
+        return jax.process_count() - 1
+
+    def on_this_rank(self) -> bool:
+        import jax
+
+        return jax.process_index() == self.rank
+
+    def active(self, step: int) -> bool:
+        """Persistent kinds stay on from ``self.step`` onward."""
+        return step >= self.step and self.on_this_rank()
+
+    def __repr__(self):
+        rank = self._rank if self._rank is not None else "<last>"
+        return f"FaultPlan({self.kind}@{self.step}@rank{rank})"
+
+
+def parse_fault_spec(spec: str) -> FaultPlan:
+    """``KIND[:PARAM]@STEP[@RANK]`` -> :class:`FaultPlan`."""
+    parts = spec.split("@")
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            f"--fault-inject expects KIND[:PARAM]@STEP[@RANK], got '{spec}'"
+        )
+    kind = parts[0]
+    param = None
+    if ":" in kind:
+        kind, raw = kind.split(":", 1)
+        param = float(raw)
+    step = int(parts[1])
+    rank = int(parts[2]) if len(parts) == 3 else None
+    return FaultPlan(kind, step, rank, param)
+
+
+_plan: Optional[FaultPlan] = None
+_last_step: int = 0
+
+
+def configure(args) -> Optional[FaultPlan]:
+    """Install the process-global fault plan from ``--fault-inject`` — or
+    DISARM a stale one when the flag is unset, so an in-process sweep
+    driver (``--suppress-crashes``) cannot leak trial 1's fault into
+    trial 2."""
+    global _plan
+    spec = getattr(args, "fault_inject", None)
+    if not spec:
+        _plan = None
+        return None
+    _plan = parse_fault_spec(spec)
+    logger.warning(f"fault injection ARMED: {_plan} (this is a chaos run)")
+    return _plan
+
+
+def reset() -> None:
+    global _plan, _last_step
+    _plan = None
+    _last_step = 0
+
+
+def note_step(step: int) -> None:
+    """Record training progress for step-keyed hooks that fire outside the
+    train step proper (collective delay, checkpoint truncation)."""
+    global _last_step
+    _last_step = step
+
+
+def maybe_skew_seed(step: int, seed: int) -> int:
+    if _plan is not None and _plan.kind == "seed-skew" and _plan.active(step):
+        return int(seed) + _SEED_SKEW_OFFSET
+    return int(seed)
+
+
+def maybe_perturb_geometry(step: int, samples: List):
+    """Drop the last row of every batched leaf of the first non-empty
+    sample, desyncing this rank's batch-geometry signature."""
+    if _plan is None or _plan.kind != "geometry-skew" or not _plan.active(step):
+        return samples
+    import jax
+
+    def chop(x):
+        if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1 and x.shape[0] > 1:
+            return np.asarray(x)[:-1]
+        return x
+
+    out = list(samples)
+    for i, sample in enumerate(out):
+        if sample is None or (hasattr(sample, "__len__") and len(sample) == 0):
+            continue
+        out[i] = jax.tree_util.tree_map(chop, sample)
+        logger.warning(
+            f"chaos: perturbed batch geometry of micro-slot {i} at step {step}"
+        )
+        break
+    return out
+
+
+def maybe_delay_collective(name: str) -> None:
+    if (
+        _plan is not None
+        and _plan.kind == "collective-delay"
+        and _plan.active(_last_step)
+    ):
+        delay = _plan.param if _plan.param is not None else _DEFAULT_DELAY_SECONDS
+        logger.warning(
+            f"chaos: delaying entry into collective '{name}' by {delay:.1f}s"
+        )
+        time.sleep(delay)
+
+
+def maybe_truncate_checkpoint(path: str) -> None:
+    """Truncate a just-written checkpoint file to half its size (simulating
+    a mid-write preemption that survived the atomic rename)."""
+    if (
+        _plan is None
+        or _plan.kind != "truncate-checkpoint"
+        or not _plan.active(_last_step)
+    ):
+        return
+    import os
+
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        logger.warning(
+            f"chaos: truncated checkpoint {path} from {size} to {size // 2} bytes"
+        )
+    except OSError as e:  # directory checkpoints (orbax) are not truncatable
+        logger.warning(f"chaos: could not truncate {path}: {e}")
+
+
+def maybe_raise(step: int) -> None:
+    if (
+        _plan is not None
+        and _plan.kind == "raise"
+        and _plan.on_this_rank()
+        and step == _plan.step
+    ):
+        raise ChaosError(
+            f"injected mid-update failure at step {step} (--fault-inject)"
+        )

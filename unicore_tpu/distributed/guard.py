@@ -1,0 +1,532 @@
+"""Cross-host consistency guard, collective watchdog, graceful preemption.
+
+Under SPMD the *gradient* cannot diverge across hosts — XLA computes it
+collectively — but the host-fed inputs can: lr/seed/step scalars, batch
+geometry, the multihost dummy-slot plan, the parsed config itself.  A
+desynced host corrupts training silently (divergent replicated jit
+inputs) or hangs forever inside a collective with no diagnosis.  The
+reference framework catches the first class by all-gathering every rank's
+grad norm and asserting near-equality (its trainer.py:1051-1084) and the
+second by treating ``all_gather_list`` unpickle failure as an
+out-of-sync-workers signal (its distributed/utils.py:340-349).  This
+module is the TPU-native analogue, in three layers:
+
+1. :class:`ConsistencyGuard` — every ``--consistency-check-interval``
+   updates, all-gather a compact per-host fingerprint (step, lr,
+   loss-scale, seed derivation, batch-geometry signature, dummy-slot plan
+   hash, startup config digest), compare across hosts, and on mismatch
+   abort with a diagnosis naming the divergent rank and the first
+   divergent field.
+2. Collective watchdog — ``run_collective`` runs each host-side
+   collective on a worker thread with a ``--collective-timeout`` budget;
+   instead of hanging forever it dumps every Python thread stack, the
+   last-known step/fingerprint, and which collective stalled, then raises
+   :class:`CollectiveTimeoutError`.
+3. Graceful preemption — SIGTERM/SIGINT set a stop flag the training loop
+   polls (``unicore_tpu_cli/train.py``): finish the in-flight update,
+   save a checkpoint, exit 0 — preemption doesn't lose work.
+
+``--suppress-crashes`` is honored naturally: the guard raises ordinary
+exceptions, and ``distributed.utils.call_main`` already swallows those
+when the flag is set.  Fault-injection hooks proving each layer fires
+live in :mod:`unicore_tpu.distributed.chaos`.
+"""
+
+import hashlib
+import logging
+import signal
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ConsistencyError(RuntimeError):
+    """Cross-host fingerprint mismatch with a named-rank diagnosis."""
+
+
+class DesyncError(ConsistencyError):
+    """A peer's collective payload failed to decode — ranks are running
+    different collectives (the reference's unpickle-failure signal)."""
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A host-side collective exceeded ``--collective-timeout``."""
+
+
+# ---------------------------------------------------------------------------
+# shared process state (what the watchdog reports when a collective stalls)
+# ---------------------------------------------------------------------------
+
+_collective_timeout: float = 0.0  # seconds; <= 0 disables the watchdog
+_last_step: int = 0
+_last_fingerprint: Optional[Dict[str, Any]] = None
+
+
+def configure(args) -> None:
+    """Install watchdog/guard config from parsed args (idempotent)."""
+    global _collective_timeout
+    _collective_timeout = float(getattr(args, "collective_timeout", 0.0) or 0.0)
+
+
+def reset() -> None:
+    """Clear process-global state (tests)."""
+    global _collective_timeout, _last_step, _last_fingerprint
+    global _worker, _requests, _poisoned, _agreed_stop_signal
+    _collective_timeout = 0.0
+    _last_step = 0
+    _last_fingerprint = None
+    _worker = None  # a poisoned/stalled worker is abandoned (daemon)
+    _requests = None
+    _poisoned = None
+    _agreed_stop_signal = None
+    _clear_stop()
+
+
+def note_step(step: int) -> None:
+    global _last_step
+    _last_step = step
+    from unicore_tpu.distributed import chaos
+
+    chaos.note_step(step)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint pieces
+# ---------------------------------------------------------------------------
+
+# args fields that legitimately differ per host and must not poison the
+# config digest: rank identity plus host-local I/O locations (scratch
+# checkpoint dirs, logging sinks, plugin paths).  Only fields that cannot
+# change the SPMD math belong here — seeds, lr, batch/mesh geometry must
+# all stay inside the digest.
+_PER_HOST_ARGS = frozenset(
+    {
+        "distributed_rank",
+        "device_id",
+        "save_dir",
+        "tmp_save_dir",
+        "restore_file",
+        "finetune_from_model",
+        "data",
+        "user_dir",
+        "tensorboard_logdir",
+        "wandb_project",
+        "wandb_name",
+    }
+)
+
+
+def config_digest(args) -> str:
+    """Stable digest of the parsed config, computed once at startup; two
+    hosts launched with different flags fail the very first check."""
+    items = sorted(
+        (k, repr(v)) for k, v in vars(args).items() if k not in _PER_HOST_ARGS
+    )
+    h = hashlib.sha256()
+    for k, v in items:
+        h.update(k.encode())
+        h.update(b"=")
+        h.update(v.encode())
+        h.update(b"\n")
+    return h.hexdigest()[:16]
+
+
+def batch_signature(sample) -> Optional[Any]:
+    """Shape/dtype signature of a host-local batch (None if empty).
+
+    Compared across hosts to agree which layout a slot can use; dtypes are
+    post-narrowing so the comparison matches what actually ships.  (Shared
+    by ``Trainer._local_sig`` and the guard's fingerprint.)"""
+    if sample is None or (hasattr(sample, "__len__") and len(sample) == 0):
+        return None
+    import jax
+
+    def _ndt(dt):
+        dt = np.dtype(dt)
+        if dt == np.int64:
+            return "int32"
+        if dt == np.float64:
+            return "float32"
+        return dt.name
+
+    leaves, treedef = jax.tree_util.tree_flatten(sample)
+    sig = []
+    for leaf in leaves:
+        if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) < 1:
+            return "unshardable"  # scalar leaf: cannot row-shard
+        sig.append((tuple(leaf.shape), _ndt(leaf.dtype)))
+    return (str(treedef), tuple(sig))
+
+
+def _short_hash(obj) -> Optional[str]:
+    if obj is None:
+        return None
+    return hashlib.sha256(repr(obj).encode()).hexdigest()[:12]
+
+
+# comparison order: the earliest divergent field is the diagnosis, so the
+# most causally-upstream fields come first (a config skew explains a seed
+# skew explains a geometry skew)
+_FIELD_ORDER = (
+    "config",
+    "seed",
+    "step",
+    "lr",
+    "loss_scale",
+    "batch_sig",
+    "dummy_plan",
+)
+
+_FINGERPRINT_TAG = "unicore-tpu-consistency-v1"
+
+
+class ConsistencyGuard:
+    """Per-trainer cross-host fingerprint checker.
+
+    ``trainer`` is duck-typed — anything exposing ``get_num_updates()``,
+    ``get_lr()``, ``current_loss_scale()`` and an ``args`` namespace works
+    (tests drive the guard with a stub, no XLA compile needed)."""
+
+    def __init__(self, args):
+        self.interval = int(
+            getattr(args, "consistency_check_interval", 0) or 0
+        )
+        self.seed = int(getattr(args, "seed", 0))
+        self.digest = config_digest(args)
+        self._last_batch_sig_hash: Optional[str] = None
+        self._last_plan_hash: Optional[str] = None
+
+    # -- trainer-side recorders (cheap; called on the hot path) ----------
+
+    def note_batch_sigs(self, sigs) -> None:
+        self._last_batch_sig_hash = _short_hash(sigs)
+
+    def note_plan(self, modes) -> None:
+        self._last_plan_hash = _short_hash(tuple(modes))
+
+    # -- fingerprint + check ---------------------------------------------
+
+    def fingerprint(self, trainer) -> Dict[str, Any]:
+        from unicore_tpu.distributed import chaos
+
+        step = int(trainer.get_num_updates())
+        return {
+            "config": self.digest,
+            "seed": chaos.maybe_skew_seed(step, self.seed),
+            "step": step,
+            "lr": float(trainer.get_lr()),
+            "loss_scale": getattr(trainer, "current_loss_scale", lambda: None)(),
+            "batch_sig": self._last_batch_sig_hash,
+            "dummy_plan": self._last_plan_hash,
+        }
+
+    def maybe_check(self, trainer) -> None:
+        """One fingerprint all-gather every ``interval`` updates.  Every
+        host reaches this at the same step counts (or the step counter
+        itself has desynced — then one side enters the collective alone
+        and the watchdog converts the hang into a diagnosed abort)."""
+        if self.interval <= 0:
+            return
+        import jax
+
+        if jax.process_count() <= 1:
+            return
+        step = int(trainer.get_num_updates())
+        if step <= 0 or step % self.interval != 0:
+            return
+        self.check_now(trainer)
+
+    def check_now(self, trainer) -> None:
+        global _last_fingerprint
+        fp = self.fingerprint(trainer)
+        _last_fingerprint = fp
+        from unicore_tpu.distributed import utils as distributed_utils
+
+        gathered = distributed_utils.all_gather_list(
+            (_FINGERPRINT_TAG, fp), max_size=1 << 14
+        )
+        diagnosis = diagnose_fingerprints(gathered)
+        if diagnosis is not None:
+            raise ConsistencyError(diagnosis)
+        logger.debug(f"consistency check passed at step {fp['step']}")
+
+
+def diagnose_fingerprints(gathered: List[Any]) -> Optional[str]:
+    """None when all hosts agree; else a diagnosis naming the divergent
+    rank(s) and the FIRST divergent field.
+
+    The reference value per field is the majority across ranks (ties break
+    toward rank 0), so a single sick host is named even when it is rank 0
+    on a 3+-host cluster."""
+    rows: List[Dict[str, Any]] = []
+    for rank, row in enumerate(gathered):
+        if (
+            not isinstance(row, tuple)
+            or len(row) != 2
+            or row[0] != _FINGERPRINT_TAG
+            or not isinstance(row[1], dict)
+        ):
+            return (
+                f"cross-host consistency check FAILED: rank {rank} sent "
+                f"{type(row).__name__} payload instead of a fingerprint — "
+                "that host is executing a DIFFERENT collective (workers out "
+                "of sync; likely a divergent control flow or crash-restart "
+                "on that rank)"
+            )
+        rows.append(row[1])
+
+    tail = (
+        "  Divergent host-fed inputs corrupt training silently under SPMD "
+        "— aborting.  (Fields compared, causally upstream first: "
+        f"{', '.join(_FIELD_ORDER)}.)"
+    )
+    for field in _FIELD_ORDER:
+        values = [r.get(field) for r in rows]
+        counts: Dict[str, int] = {}
+        for v in values:
+            counts[repr(v)] = counts.get(repr(v), 0) + 1
+        if len(counts) <= 1:
+            continue
+        best = max(counts.values())
+        step = rows[0].get("step")
+        if sum(1 for c in counts.values() if c == best) > 1:
+            # no strict majority (e.g. 2 hosts, or a 2-2 split): naming one
+            # side as "the" divergent rank would confidently send the
+            # operator to debug the wrong machine — name the ranks that
+            # differ from rank 0 as suspects and say the vote is ambiguous
+            divergent = [
+                i for i, v in enumerate(values)
+                if repr(v) != repr(values[0])
+            ]
+            ranks = ", ".join(f"rank {i}" for i in divergent)
+            detail = "; ".join(
+                f"rank {i} has {field}={values[i]!r}"
+                for i in range(len(values))
+            )
+            return (
+                f"cross-host consistency check FAILED at step {step}: "
+                f"{ranks} differ(s) from rank 0 on field '{field}' "
+                f"({detail}) and no majority exists among "
+                f"{len(values)} host(s) — the faulty side cannot be "
+                "determined from the vote; compare the listed values "
+                "against the intended launch config." + tail
+            )
+        majority = max(counts.items(), key=lambda kv: kv[1])[0]
+        divergent = [i for i, v in enumerate(values) if repr(v) != majority]
+        agree = len(values) - len(divergent)
+        ranks = ", ".join(f"rank {i}" for i in divergent)
+        detail = "; ".join(
+            f"rank {i} has {field}={values[i]!r}" for i in divergent
+        )
+        return (
+            f"cross-host consistency check FAILED at step {step}: "
+            f"{ranks} diverge(s) on field '{field}': {detail}, while "
+            f"{agree} other rank(s) agree on {field}={majority}." + tail
+        )
+    return None
+
+
+# ---------------------------------------------------------------------------
+# collective watchdog
+# ---------------------------------------------------------------------------
+
+def format_thread_stacks() -> str:
+    """Every live Python thread's stack, watchdog-report style."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(
+            f"--- thread {names.get(ident, '?')} (ident {ident}) ---"
+        )
+        out.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(out)
+
+
+# One persistent worker runs the collectives (no per-call thread churn on
+# the hot path).  After a timeout the worker may still be blocked inside
+# the stalled collective, so the plane is POISONED: letting a later
+# collective run would pair the orphan's eventual completion against the
+# peers' next collective — silent payload crossover.  --suppress-crashes
+# sweep drivers that swallow the timeout hit the poisoned error instead.
+_worker: Optional[threading.Thread] = None
+_requests = None  # queue.Queue created with the worker
+_poisoned: Optional[str] = None
+
+
+def _worker_loop(requests) -> None:
+    me = threading.current_thread()
+    while True:
+        item = requests.get()
+        if item is None:
+            return
+        name, fn, box, done = item
+        me.name = f"collective-{name}"  # stack dumps show what's stalled
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # surface worker failures to the caller
+            box["error"] = e
+        finally:
+            me.name = "collective-watchdog-idle"
+            done.set()
+
+
+def _ensure_worker():
+    global _worker, _requests
+    if _worker is None or not _worker.is_alive():
+        import queue
+
+        _requests = queue.Queue()
+        _worker = threading.Thread(
+            target=_worker_loop,
+            args=(_requests,),
+            name="collective-watchdog-idle",
+            daemon=True,
+        )
+        _worker.start()
+    return _requests
+
+
+def run_collective(name: str, fn):
+    """Run one host-side collective under the watchdog.
+
+    With the watchdog disabled (``--collective-timeout 0``) this is a
+    direct call.  Otherwise the collective runs on the persistent worker
+    thread and the caller waits up to the timeout; on expiry the process
+    dumps every thread stack plus the last-known step/fingerprint, poisons
+    the collective plane (further collectives raise immediately — the
+    orphaned worker may complete the stalled collective later, and letting
+    a new one proceed would pair mismatched payloads across hosts), and
+    raises — a stalled collective becomes a diagnosed abort instead of an
+    infinite hang."""
+    global _worker, _poisoned
+    from unicore_tpu.distributed import chaos
+
+    timeout = _collective_timeout
+    if timeout <= 0:
+        chaos.maybe_delay_collective(name)
+        return fn()
+    if _poisoned is not None:
+        raise CollectiveTimeoutError(
+            f"collective '{name}' refused: the collective plane was "
+            f"poisoned by an earlier watchdog timeout ({_poisoned}) and "
+            "this process can no longer exchange data with its peers "
+            "coherently; restart the process"
+        )
+
+    def work():
+        chaos.maybe_delay_collective(name)  # delays count against the budget
+        return fn()
+
+    requests = _ensure_worker()
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+    requests.put((name, work, box, done))
+    if not done.wait(timeout):
+        stacks = format_thread_stacks()
+        msg = (
+            f"collective '{name}' stalled for more than {timeout:.1f}s "
+            f"(--collective-timeout).  Last known step: {_last_step}; last "
+            f"fingerprint: {_last_fingerprint}.  A peer host has likely "
+            "desynced, crashed, or been preempted; raising instead of "
+            "hanging forever."
+        )
+        _poisoned = f"'{name}' at step {_last_step}"
+        _worker = None  # the old worker is lost inside the stalled call
+        logger.error(msg + "\nPython thread stacks at stall:\n" + stacks)
+        raise CollectiveTimeoutError(msg)
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption (SIGTERM/SIGINT)
+# ---------------------------------------------------------------------------
+
+_stop_event = threading.Event()
+_stop_signal: Optional[str] = None
+
+
+def _clear_stop() -> None:
+    global _stop_signal
+    _stop_event.clear()
+    _stop_signal = None
+
+
+def _handle_stop_signal(signum, frame) -> None:
+    global _stop_signal
+    name = signal.Signals(signum).name
+    if signum == signal.SIGINT and _stop_signal == "SIGINT":
+        # second ^C: the operator wants OUT, not another checkpoint.  (A
+        # SIGTERM followed by one ^C stays graceful — the first ^C after a
+        # manager-sent SIGTERM must not kill the promised checkpoint.)
+        raise KeyboardInterrupt
+    _stop_signal = name
+    _stop_event.set()
+    logger.warning(
+        f"received {name}: graceful stop requested — will finish the "
+        "in-flight update, save a checkpoint, and exit 0"
+        + (" (send SIGINT again to abort immediately)"
+           if signum == signal.SIGINT else "")
+    )
+
+
+def install_signal_handlers() -> bool:
+    """SIGTERM/SIGINT request a graceful stop instead of killing the run
+    mid-update.  Returns False when handlers can't be installed (non-main
+    thread, embedded interpreter) — the run proceeds unguarded."""
+    try:
+        signal.signal(signal.SIGTERM, _handle_stop_signal)
+        signal.signal(signal.SIGINT, _handle_stop_signal)
+        return True
+    except ValueError:  # not the main thread of the main interpreter
+        logger.warning(
+            "could not install SIGTERM/SIGINT handlers (not the main "
+            "thread); preemption will not checkpoint"
+        )
+        return False
+
+
+def stop_requested() -> Optional[str]:
+    """The signal name once a graceful stop was requested, else None."""
+    return _stop_signal if _stop_event.is_set() else None
+
+
+_agreed_stop_signal: Optional[str] = None
+
+
+def note_gathered_stop_flags(flags) -> None:
+    """Record the OR of every host's stop flag, as carried by the
+    trainer's existing per-update slot-plan all-gather — the stop decision
+    piggybacks on a collective the hot loop already pays for instead of
+    adding its own round per update."""
+    global _agreed_stop_signal
+    for flag in flags:
+        if flag:
+            _agreed_stop_signal = flag
+            return
+
+
+def stop_requested_global() -> Optional[str]:
+    """Collectively-agreed stop decision: the signal name once ANY host's
+    graceful-stop flag has been seen by the shared all-gather, else None.
+
+    Signals land asynchronously — host A's SIGTERM can arrive before its
+    post-step stop check while host B's arrives just after B passed it.
+    Without agreement, A saves and exits while B runs one more update and
+    hangs alone in its next collective until the watchdog kills it WITHOUT
+    a checkpoint.  On multi-host, ONLY the agreed flag counts (a host's
+    local flag propagates via the next update's slot-plan gather, so the
+    stop lands at most one update late but on EVERY host at the same
+    update).  Single-host returns the local flag directly."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return stop_requested()
+    return _agreed_stop_signal

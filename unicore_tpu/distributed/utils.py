@@ -19,9 +19,19 @@ import numpy as np
 
 import jax
 
+from unicore_tpu.distributed import guard
+
 logger = logging.getLogger(__name__)
 
 _initialized = False
+
+
+def _timed(name, fn):
+    """Run one host collective under the watchdog (guard.run_collective):
+    with ``--collective-timeout`` set, a stalled peer turns into a
+    diagnosed abort (thread stacks + last fingerprint) instead of an
+    infinite hang."""
+    return guard.run_collective(name, fn)
 
 
 def infer_init_method(args):
@@ -65,6 +75,18 @@ def distributed_init(args) -> int:
             f"initializing jax.distributed: coordinator={coordinator} "
             f"process={process_id}/{num_processes}"
         )
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # multi-process CPU runs (virtual-mesh smoke tests, CI) need the
+            # gloo collectives backend — the default CPU client refuses
+            # cross-process computations outright.  Checked via the env var:
+            # probing jax.default_backend() here would initialize the
+            # backend before jax.distributed.initialize.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:
+                pass  # older/newer jax without the option: keep defaults
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -162,6 +184,10 @@ def all_reduce(tensor, op="sum"):
     """Host-level all-reduce of a small array across processes."""
     if jax.process_count() == 1:
         return tensor
+    return _timed("all_reduce", lambda: _all_reduce_impl(tensor, op))
+
+
+def _all_reduce_impl(tensor, op):
     from jax.experimental import multihost_utils
 
     arr = np.asarray(tensor)
@@ -186,9 +212,20 @@ def all_gather_list(data, group=None, max_size=None):
     LARGEST host's length — so payloads of any size work and small payloads
     never pay for a large fixed buffer.  Passing ``max_size`` keeps the
     reference's single-round fixed-buffer behavior (one collective instead
-    of two; errors if the payload doesn't fit)."""
+    of two; errors if the payload doesn't fit).
+
+    A row that fails to unpickle is NOT re-raised raw: it means that peer
+    is executing a DIFFERENT collective (out-of-sync workers — the
+    reference's utils.py:340-349 signal), so it surfaces as a
+    :class:`~unicore_tpu.distributed.guard.DesyncError` naming the rank."""
     if jax.process_count() == 1:
         return [data]
+    return _timed(
+        "all_gather_list", lambda: _all_gather_list_impl(data, max_size)
+    )
+
+
+def _all_gather_list_impl(data, max_size):
     import pickle
 
     from jax.experimental import multihost_utils
@@ -212,10 +249,32 @@ def all_gather_list(data, group=None, max_size=None):
     buf[:8] = header
     buf[8 : 8 + len(payload)] = payload
     gathered = multihost_utils.process_allgather(buf)
+    return _decode_gathered_rows(gathered)
+
+
+def _decode_gathered_rows(gathered):
+    """Decode each rank's length-prefixed pickle row; an undecodable row is
+    diagnosed as that rank being out of sync rather than a raw traceback."""
+    import pickle
+
     out = []
-    for row in gathered:
-        n = int(np.frombuffer(row[:8].tobytes(), dtype=np.uint64)[0])
-        out.append(pickle.loads(row[8 : 8 + n].tobytes()))
+    for rank, row in enumerate(gathered):
+        row = np.asarray(row, dtype=np.uint8)
+        try:
+            n = int(np.frombuffer(row[:8].tobytes(), dtype=np.uint64)[0])
+            if n > len(row) - 8:
+                raise ValueError(
+                    f"length header {n} exceeds buffer ({len(row) - 8})"
+                )
+            out.append(pickle.loads(row[8 : 8 + n].tobytes()))
+        except Exception as e:
+            raise guard.DesyncError(
+                f"all_gather_list: could not decode the payload from rank "
+                f"{rank} ({type(e).__name__}: {e}).  That rank is most "
+                "likely executing a DIFFERENT collective — workers are out "
+                "of sync (divergent control flow, crash-restart, or a "
+                "desynced step counter on that host)."
+            ) from e
     return out
 
 
@@ -226,7 +285,7 @@ def all_reduce_dict(data: Dict[str, Any], device=None, group=None) -> Dict[str, 
         return dict(data)
     keys = sorted(data.keys())
     vec = np.asarray([float(data[k]) for k in keys], dtype=np.float64)
-    out = all_reduce(vec, op="sum")
+    out = _timed("all_reduce_dict", lambda: _all_reduce_impl(vec, "sum"))
     return {k: out[i] for i, k in enumerate(keys)}
 
 
@@ -268,7 +327,10 @@ def all_to_all(tensor, group=None):
         )
     rows = arr.shape[0] // n
     me = jax.process_index()
-    gathered = multihost_utils.process_allgather(_as_bytes(arr))  # (n, bytes)
+    gathered = _timed(
+        "all_to_all",
+        lambda: multihost_utils.process_allgather(_as_bytes(arr)),
+    )  # (n, bytes)
     return np.concatenate(
         [
             _from_bytes(gathered[src], arr.shape, arr.dtype)[
@@ -286,6 +348,13 @@ def broadcast_tensors(tensors, src_rank=0, group=None, dist_device=None):
     metadata first, then each tensor)."""
     if jax.process_count() == 1:
         return tensors
+    return _timed(
+        "broadcast_tensors",
+        lambda: _broadcast_tensors_impl(tensors, src_rank),
+    )
+
+
+def _broadcast_tensors_impl(tensors, src_rank):
     from jax.experimental import multihost_utils
 
     is_source = jax.process_index() == src_rank
@@ -297,7 +366,7 @@ def broadcast_tensors(tensors, src_rank=0, group=None, dist_device=None):
         if is_source
         else None
     )
-    meta = broadcast_object(meta, src_rank=src_rank)
+    meta = _broadcast_object_impl(meta, src_rank)
     out = []
     for i, (shape, dtype) in enumerate(meta):
         nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
@@ -322,6 +391,12 @@ def broadcast_object(obj, src_rank=0, group=None):
     """
     if jax.process_count() == 1:
         return obj
+    return _timed(
+        "broadcast_object", lambda: _broadcast_object_impl(obj, src_rank)
+    )
+
+
+def _broadcast_object_impl(obj, src_rank):
     import pickle
 
     from jax.experimental import multihost_utils
@@ -342,4 +417,30 @@ def broadcast_object(obj, src_rank=0, group=None):
                           dtype=np.uint64)[0])
     buf = payload if is_source else np.zeros((n,), dtype=np.uint8)
     out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
-    return pickle.loads(np.asarray(out).tobytes())
+    try:
+        # the explicit uint8 cast is load-bearing: broadcast_one_to_all is
+        # a psum under the hood and some backends (gloo CPU collectives)
+        # return the accumulator dtype (uint32) — .tobytes() on that would
+        # interleave zero bytes into the pickle stream
+        return pickle.loads(np.asarray(out, dtype=np.uint8).tobytes())
+    except Exception as e:
+        raise guard.DesyncError(
+            f"broadcast_object: could not decode the payload from source "
+            f"rank {src_rank} ({type(e).__name__}: {e}) — this host is most "
+            "likely out of sync with the source (executing a different "
+            "collective)."
+        ) from e
+
+
+def barrier(tag: str = "barrier") -> None:
+    """Watchdog-timed host barrier (``sync_global_devices``): all hosts
+    must reach the same ``tag`` — with ``--collective-timeout`` set, a
+    missing peer raises a diagnosed :class:`CollectiveTimeoutError`
+    instead of hanging forever."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    _timed(
+        f"barrier:{tag}", lambda: multihost_utils.sync_global_devices(tag)
+    )

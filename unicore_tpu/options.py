@@ -279,6 +279,13 @@ def add_dataset_args(parser, train=False, gen=False):
                        help="batch size will be a multiplier of this value")
     group.add_argument("--data-buffer-size", default=10, type=int, metavar="N",
                        help="number of batches to preload / double-buffer onto device")
+    group.add_argument("--data-stall-timeout", default=0.0, type=float,
+                       metavar="SECS",
+                       help="escalate the data-pipeline starvation warning: "
+                            "if the prefetch producer delivers nothing for "
+                            "this many seconds, raise a diagnosable error "
+                            "naming the dataset/epoch position instead of "
+                            "warning forever (0 disables)")
     if train:
         group.add_argument("--train-subset", default="train", metavar="SPLIT",
                            help="data subset to use for training (e.g. train, valid, test)")
@@ -361,6 +368,26 @@ def add_distributed_training_args(parser, default_world_size=None):
                        help="size of the 'expert' mesh axis for MoE layers")
     group.add_argument("--zero-shard-optimizer", action="store_true",
                        help="shard fp32 master params + optimizer state over the data axis (ZeRO-1)")
+    # robustness subsystem (distributed/guard.py, docs/robustness.md)
+    group.add_argument("--consistency-check-interval", type=int, default=100,
+                       metavar="N",
+                       help="all-gather and compare a per-host fingerprint "
+                            "(step/lr/loss-scale/seed/batch-geometry/"
+                            "dummy-plan/config digest) every N updates and "
+                            "abort with a named-rank diagnosis on mismatch "
+                            "(multi-host only; 0 disables)")
+    group.add_argument("--collective-timeout", type=float, default=1800.0,
+                       metavar="SECS",
+                       help="watchdog budget for host-side collectives: a "
+                            "collective stalled longer than this dumps all "
+                            "thread stacks + the last fingerprint and raises "
+                            "instead of hanging forever (0 disables)")
+    group.add_argument("--fault-inject", type=str, default=None,
+                       metavar="KIND[:PARAM]@STEP[@RANK]",
+                       help="chaos harness (distributed/chaos.py): inject "
+                            "seed-skew, geometry-skew, collective-delay, "
+                            "truncate-checkpoint, or raise at STEP on RANK "
+                            "(default: last rank) to prove the guards fire")
     return group
 
 

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from unicore_tpu import checkpoint_utils, utils
+from unicore_tpu.distributed import chaos, guard
 from unicore_tpu.distributed import utils as distributed_utils
 from unicore_tpu.ema import ema_to_model_dtype, init_ema, update_ema
 from unicore_tpu.logging import meters, metrics
@@ -139,6 +140,12 @@ class Trainer(object):
         self._previous_training_time = 0
         self._cumulative_training_time = None
 
+        # robustness subsystem: collective watchdog config, fault-injection
+        # plan, and the cross-host consistency guard (distributed/guard.py)
+        guard.configure(args)
+        chaos.configure(args)
+        self.guard = guard.ConsistencyGuard(args)
+
         metrics.log_start_time("wall", priority=790, round=2)
 
     # ------------------------------------------------------------------
@@ -198,6 +205,14 @@ class Trainer(object):
     @property
     def params(self):
         return self._state["params"] if self._state is not None else None
+
+    def current_loss_scale(self):
+        """Host-side loss-scale value (None before state init) — part of
+        the consistency-guard fingerprint, so it's fetched only at check
+        intervals, never on the hot path."""
+        if self._state is None:
+            return None
+        return float(jax.device_get(self._state["loss_scale"]))
 
     # ------------------------------------------------------------------
     # state init
@@ -614,10 +629,17 @@ class Trainer(object):
     def _step_scalars(self, micro_i=0, weight=1.0, seed=None):
         """Small host->device scalar bundle for one step; everything else
         (rng folding, lr math) happens inside the compiled step."""
+        step = self.get_num_updates()
         return {
             "lr": np.float32(self.get_lr()),
-            "seed": np.int32(self.args.seed if seed is None else seed),
-            "step": np.int32(self.get_num_updates()),
+            # chaos seed-skew routes through here so the injected desync is
+            # exactly the one the consistency guard's 'seed' field catches
+            "seed": np.int32(
+                chaos.maybe_skew_seed(
+                    step, self.args.seed if seed is None else seed
+                )
+            ),
+            "step": np.int32(step),
             "micro_i": np.int32(micro_i),
             "weight": np.float32(weight),
         }
@@ -682,6 +704,10 @@ class Trainer(object):
     @metrics.aggregate("train")
     def train_step(self, samples):
         """One update from a list of micro-batches (GroupedIterator chunk)."""
+        # fault-injection hooks (no-ops unless --fault-inject armed a plan)
+        chaos.maybe_raise(self.get_num_updates())
+        samples = chaos.maybe_perturb_geometry(self.get_num_updates(), samples)
+
         if self._state is None:
             first_real = next((s for s in samples if s), None)
             assert first_real is not None, "cannot init from all-dummy step"
@@ -731,6 +757,11 @@ class Trainer(object):
         self._state = new_state
         self._cached_eval_params = None
         self.set_num_updates(self.get_num_updates() + 1)
+        # cross-host fingerprint check every --consistency-check-interval
+        # updates (multi-host only; raises ConsistencyError naming the
+        # divergent rank + field).  note_step feeds the watchdog's report.
+        guard.note_step(self.get_num_updates())
+        self.guard.maybe_check(self)
 
         if getattr(self.args, "nan_rerun", False) and not self.use_loss_scale:
             # opt-in reference parity (trainer.py:727-748): pay one host
@@ -916,25 +947,10 @@ class Trainer(object):
         """Shape/dtype signature of a host-local batch (None if empty).
 
         Compared across hosts to agree which layout a slot can use; dtypes
-        are post-narrowing so the comparison matches what actually ships."""
-        if self._is_empty(sample):
-            return None
-
-        def _ndt(dt):
-            dt = np.dtype(dt)
-            if dt == np.int64:
-                return "int32"
-            if dt == np.float64:
-                return "float32"
-            return dt.name
-
-        leaves, treedef = jax.tree_util.tree_flatten(sample)
-        sig = []
-        for leaf in leaves:
-            if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) < 1:
-                return "unshardable"  # scalar leaf: cannot row-shard
-            sig.append((tuple(leaf.shape), _ndt(leaf.dtype)))
-        return (str(treedef), tuple(sig))
+        are post-narrowing so the comparison matches what actually ships.
+        (The computation lives in guard.batch_signature so the consistency
+        guard fingerprints the exact same geometry the slot plan uses.)"""
+        return guard.batch_signature(sample)
 
     def _plan_slots(self, samples):
         """Multi-host only: agree, across hosts, how each micro-slot's batch
@@ -959,9 +975,16 @@ class Trainer(object):
         from unicore_tpu.parallel import DATA_AXIS
 
         sigs = [self._local_sig(s) for s in samples]
+        self.guard.note_batch_sigs(sigs)
         # fixed max_size keeps this ONE collective round (auto-sizing would
-        # add a length-gather round on the hot path); signatures are tiny
-        all_sigs = distributed_utils.all_gather_list(sigs, max_size=1 << 16)
+        # add a length-gather round on the hot path); signatures are tiny.
+        # The graceful-stop flag rides along so the CLI's stop decision is
+        # collectively agreed without its own per-update collective.
+        gathered = distributed_utils.all_gather_list(
+            (sigs, guard.stop_requested()), max_size=1 << 16
+        )
+        all_sigs = [row[0] for row in gathered]
+        guard.note_gathered_stop_flags(row[1] for row in gathered)
         nproc = jax.process_count()
         data_size = self.mesh.shape[DATA_AXIS]
         local_shards = data_size // nproc if data_size % nproc == 0 else 0
@@ -981,6 +1004,7 @@ class Trainer(object):
                 modes.append("shard")
             else:
                 modes.append("gather")
+        self.guard.note_plan(modes)
         return modes
 
     def _prepare_shard_global(self, sample):
@@ -1155,6 +1179,7 @@ class Trainer(object):
             epoch=epoch,
             data_buffer_size=self.args.data_buffer_size,
             disable_iterator_cache=disable_iterator_cache,
+            data_stall_timeout=getattr(self.args, "data_stall_timeout", 0.0),
         )
         self.reset_dummy_batch(batch_iterator.first_batch)
         return batch_iterator
@@ -1173,6 +1198,7 @@ class Trainer(object):
             epoch=1,
             data_buffer_size=self.args.data_buffer_size,
             disable_iterator_cache=disable_iterator_cache,
+            data_stall_timeout=getattr(self.args, "data_stall_timeout", 0.0),
         )
         self.reset_dummy_batch(batch_iterator.first_batch)
         return batch_iterator
@@ -1264,10 +1290,9 @@ class Trainer(object):
         path = os.path.abspath(filename)
         if self.is_data_parallel_master and os.path.lexists(path):
             _sh.rmtree(path, ignore_errors=True)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("orbax_pre_save")
+        # watchdog-timed barrier (raw sync_global_devices would hang
+        # forever on a desynced peer; see the untimed-collective lint rule)
+        distributed_utils.barrier("orbax_pre_save")
         ckptr = self._orbax_ckptr()
         ckptr.save(path, self._orbax_state_to_save())
         ckptr.wait_until_finished()

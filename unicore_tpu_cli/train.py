@@ -79,9 +79,25 @@ class TrainSession:
 
     # -- stop conditions ------------------------------------------------
 
-    def hard_stop_reason(self) -> Optional[str]:
+    _SIG_UNQUERIED = object()  # "caller did not supply a stop decision"
+
+    def hard_stop_reason(self, preempt_sig=_SIG_UNQUERIED) -> Optional[str]:
         """Unconditional stop checks (budget-style limits, checked every
-        inner step): update budget and wall-clock budget."""
+        inner step): a pending graceful-stop signal (``preempt_sig``, the
+        COLLECTIVELY agreed SIGTERM/SIGINT decision — every host must stop
+        at the same update or the survivors hang in the next collective;
+        an explicit None means "agreed: no stop" and is NOT re-sampled,
+        which could diverge from the peers), update budget, and wall-clock
+        budget."""
+        if preempt_sig is TrainSession._SIG_UNQUERIED:
+            from unicore_tpu.distributed import guard
+
+            preempt_sig = guard.stop_requested()  # local-only convenience
+        if preempt_sig:
+            return (
+                f"received {preempt_sig}: graceful stop — the in-flight "
+                "update finished; saving a checkpoint and exiting 0"
+            )
         n = self.trainer.get_num_updates()
         if self.args.max_update and n >= self.args.max_update:
             return f"num_updates: {n} hit --max-update ({self.args.max_update})"
@@ -139,8 +155,13 @@ class TrainSession:
         validation and/or write checkpoints per the cadence, and report
         (validation losses, should_stop)."""
         from unicore_tpu import checkpoint_utils
+        from unicore_tpu.distributed import guard
 
-        reason = self.hard_stop_reason()
+        # ONE collective agreement per step: both the stop decision and the
+        # skip-validation decision must be identical on every host (a host
+        # validating while its peers skip desyncs the validation collectives)
+        preempt_sig = guard.stop_requested_global()
+        reason = self.hard_stop_reason(preempt_sig)
         if reason:
             logger.info(f"stopping training: {reason}")
         stopping = reason is not None
@@ -148,6 +169,9 @@ class TrainSession:
         do_save, do_validate = self.cadence(
             epoch_itr.epoch, end_of_epoch, stopping
         )
+        if preempt_sig:
+            # preemption budget is short: save and get out, skip validation
+            do_validate = False
 
         valid_losses: List[Optional[float]] = [None]
         if do_validate:
@@ -181,11 +205,17 @@ class TrainSession:
 
 def main(args) -> None:
     from unicore_tpu import checkpoint_utils, tasks, utils
+    from unicore_tpu.distributed import guard
     from unicore_tpu.distributed import utils as distributed_utils
     from unicore_tpu.logging import metrics
     from unicore_tpu.trainer import Trainer
 
     utils.import_user_module(args)
+
+    # SIGTERM/SIGINT request a graceful stop: finish the in-flight update,
+    # save a checkpoint, exit 0 — preemption doesn't lose work (a second
+    # SIGINT aborts immediately)
+    guard.install_signal_handlers()
 
     assert args.batch_size is not None, (
         "Must specify batch size either with --batch-size"
