@@ -18,6 +18,7 @@ bottleneck warning.  Implementation original to this framework:
   classes are pull-based (``__next__``) rather than generator-wrapped.
 """
 
+import contextlib
 import itertools
 import logging
 import math
@@ -34,6 +35,33 @@ logger = logging.getLogger(__name__)
 
 # queue sentinel: the producer thread finished cleanly
 _DONE = object()
+
+# Depth of active skip() fast-forwards on the consumer side.  While > 0,
+# BufferedIterator's --data-stall-timeout budget is RELAXED (x10, below):
+# in steady state the prefetch buffer amortizes per-batch latency
+# variance (an occasionally-slow batch never starves the consumer, whose
+# pulls return instantly from the buffer), but a tight skip loop drains
+# the buffer and exposes raw per-batch production latency to the stall
+# clock — a budget tuned to steady-state pulls would false-trip on a
+# healthy pipeline.  The budget is relaxed rather than suspended so a
+# producer that wedges outright MID-SKIP (dead mount, stuck LMDB read)
+# still becomes a diagnosed DataStallError, never an unbounded hang.
+# The normal budget re-arms on the first pull after the skip.
+# Consumer-side only (one training thread): a plain counter suffices.
+_stall_relaxed = 0
+_SKIP_STALL_BUDGET_MULTIPLIER = 10.0
+
+
+@contextlib.contextmanager
+def relaxed_stall_watchdog():
+    """Relax the BufferedIterator stall budget (x10) for the enclosed
+    fast-forward (re-entrant)."""
+    global _stall_relaxed
+    _stall_relaxed += 1
+    try:
+        yield
+    finally:
+        _stall_relaxed -= 1
 
 
 class CountingIterator(object):
@@ -72,9 +100,15 @@ class CountingIterator(object):
         return self.n < self.total
 
     def skip(self, num_to_skip):
-        """Consume and discard ``num_to_skip`` items."""
-        for _ in itertools.islice(self, num_to_skip):
-            pass
+        """Consume and discard ``num_to_skip`` items.  The data-stall
+        budget is relaxed (x10) for the duration: fast-forwarding (resume
+        offsets, the health sentinel's post-rewind skip-ahead) waits on
+        raw per-batch production with no prefetch buffer to amortize it,
+        which must not read as a stalled pipeline — while a producer that
+        truly wedges mid-skip still raises instead of hanging."""
+        with relaxed_stall_watchdog():
+            for _ in itertools.islice(self, num_to_skip):
+                pass
         return self
 
     def take(self, n):
@@ -503,10 +537,10 @@ class BufferedIterator(object):
         )
         self._last_warn = now
 
-    def _get_with_stall_watchdog(self):
-        """Block for the next item, but never past ``stall_timeout`` of
+    def _get_with_stall_watchdog(self, budget):
+        """Block for the next item, but never past ``budget`` seconds of
         total producer silence."""
-        deadline = time.time() + self._stall_timeout
+        deadline = time.time() + budget
         while True:
             remaining = deadline - time.time()
             if remaining <= 0:
@@ -514,10 +548,16 @@ class BufferedIterator(object):
                 alive = (
                     self._producer is not None and self._producer.is_alive()
                 )
+                relaxed = (
+                    " (relaxed x10 budget: this happened DURING a skip "
+                    "fast-forward)"
+                    if budget > self._stall_timeout
+                    else ""
+                )
                 raise DataStallError(
                     f"data pipeline stalled: the prefetch producer delivered "
-                    f"nothing for {self._stall_timeout:.0f}s "
-                    f"(--data-stall-timeout) at position "
+                    f"nothing for {budget:.0f}s "
+                    f"(--data-stall-timeout){relaxed} at position "
                     f"{self._delivered}/{self.total}{where}; producer thread "
                     f"{'is still alive but wedged' if alive else 'has DIED'}."
                     "  Check the dataset storage (mount, LMDB file, remote "
@@ -539,7 +579,10 @@ class BufferedIterator(object):
             self._start_producer()
         self._maybe_warn_starved()
         if self._stall_timeout > 0:
-            item = self._get_with_stall_watchdog()
+            budget = self._stall_timeout * (
+                _SKIP_STALL_BUDGET_MULTIPLIER if _stall_relaxed else 1.0
+            )
+            item = self._get_with_stall_watchdog(budget)
         else:
             item = self._queue.get(True)
         if isinstance(item, Exception):

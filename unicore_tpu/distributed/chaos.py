@@ -27,14 +27,27 @@ Kinds (all persistent from STEP onward unless noted):
 ``raise``
     Raises :class:`ChaosError` out of ``train_step`` at exactly STEP
     (one-shot), exercising crash paths (--suppress-crashes, sweep drivers).
+``loss-spike[:MAGNITUDE]``
+    At exactly STEP, the update's gradients AND the reported training
+    loss are scaled by MAGNITUDE (default 100) inside the jitted step —
+    the numerical divergence the training-health sentinel
+    (unicore_tpu/health/) must detect, rewind, and skip past.  Fires on
+    EVERY rank (the multipliers feed replicated jit inputs; a per-rank
+    value would be a host desync, which ``seed-skew`` already covers),
+    and is consumed once the step counter advances past STEP, so a
+    sentinel rewind that replays the counter cannot re-trigger it.
+``grad-explosion[:SCALE]``
+    Same mechanics, but only the gradients are scaled (default 100) —
+    the reported loss stays healthy, proving the grad-norm detector
+    fires independently of the loss band.
 
-RANK defaults to the LAST process (rank ``process_count - 1``): on a
-2-host cluster the fault lands on rank 1 while rank 0 — coordinator and
-checkpoint writer — stays healthy to report the diagnosis; single-host
-runs target rank 0 so every kind stays testable without a cluster.
-Exception: ``truncate-checkpoint`` defaults to rank 0, the only rank that
-writes checkpoints — targeting the last rank would be a silent no-op on
-multi-host runs.
+For the rank-targetable kinds, RANK defaults to the LAST process (rank
+``process_count - 1``): on a 2-host cluster the fault lands on rank 1
+while rank 0 — coordinator and checkpoint writer — stays healthy to
+report the diagnosis; single-host runs target rank 0 so every kind stays
+testable without a cluster.  Exception: ``truncate-checkpoint`` defaults
+to rank 0, the only rank that writes checkpoints — targeting the last
+rank would be a silent no-op on multi-host runs.
 
 A fault plan is process-global (``configure(args)``); ``reset()`` clears
 it (tests).  With no ``--fault-inject`` every hook is a cheap no-op.
@@ -54,10 +67,17 @@ KINDS = (
     "collective-delay",
     "truncate-checkpoint",
     "raise",
+    "loss-spike",
+    "grad-explosion",
 )
+
+# metric-fault kinds perturb REPLICATED jit inputs, so they must fire
+# identically on every rank — @RANK targeting is rejected for them
+_ALL_RANK_KINDS = ("loss-spike", "grad-explosion")
 
 _SEED_SKEW_OFFSET = 1000
 _DEFAULT_DELAY_SECONDS = 30.0
+_DEFAULT_FAULT_MAGNITUDE = 100.0
 
 
 class ChaosError(RuntimeError):
@@ -73,10 +93,19 @@ class FaultPlan:
             raise ValueError(
                 f"unknown fault kind '{kind}' (choose from {', '.join(KINDS)})"
             )
+        if kind in _ALL_RANK_KINDS and rank is not None:
+            raise ValueError(
+                f"'{kind}' fires on every rank (its multipliers feed "
+                "replicated jit inputs — a per-rank value would desync the "
+                "hosts); drop the @RANK part"
+            )
         self.kind = kind
         self.step = step
         self._rank = rank  # None = resolve to last rank at trigger time
         self.param = param
+        self.consumed = False  # one-shot metric faults: never refire after
+        # the step counter has advanced past STEP (sentinel rewinds replay
+        # the counter through STEP with skipped-ahead data)
 
     @property
     def rank(self) -> int:
@@ -92,6 +121,8 @@ class FaultPlan:
         return jax.process_count() - 1
 
     def on_this_rank(self) -> bool:
+        if self.kind in _ALL_RANK_KINDS:
+            return True
         import jax
 
         return jax.process_index() == self.rank
@@ -101,6 +132,8 @@ class FaultPlan:
         return step >= self.step and self.on_this_rank()
 
     def __repr__(self):
+        if self.kind in _ALL_RANK_KINDS:
+            return f"FaultPlan({self.kind}@{self.step}@all-ranks)"
         rank = self._rank if self._rank is not None else "<last>"
         return f"FaultPlan({self.kind}@{self.step}@rank{rank})"
 
@@ -149,15 +182,47 @@ def reset() -> None:
 
 def note_step(step: int) -> None:
     """Record training progress for step-keyed hooks that fire outside the
-    train step proper (collective delay, checkpoint truncation)."""
+    train step proper (collective delay, checkpoint truncation), and mark
+    one-shot metric faults consumed once the counter has advanced past
+    their trigger — a sentinel rewind replays the counter through the
+    trigger step, and refiring there would make the run unhealable."""
     global _last_step
     _last_step = step
+    if (
+        _plan is not None
+        and _plan.kind in _ALL_RANK_KINDS
+        and step > _plan.step
+    ):
+        _plan.consumed = True
 
 
 def maybe_skew_seed(step: int, seed: int) -> int:
     if _plan is not None and _plan.kind == "seed-skew" and _plan.active(step):
         return int(seed) + _SEED_SKEW_OFFSET
     return int(seed)
+
+
+def fault_multipliers(step: int):
+    """``(loss_mul, grad_mul)`` the trainer feeds into the jitted step's
+    scalar bundle.  Both are 1.0 (a numerical no-op) except at exactly the
+    armed ``loss-spike``/``grad-explosion`` trigger step — and never again
+    once the counter has advanced past it (see :func:`note_step`)."""
+    if (
+        _plan is None
+        or _plan.kind not in _ALL_RANK_KINDS
+        or _plan.consumed
+        or step != _plan.step
+    ):
+        return 1.0, 1.0
+    mag = float(
+        _plan.param if _plan.param is not None else _DEFAULT_FAULT_MAGNITUDE
+    )
+    logger.warning(
+        f"chaos: injecting {_plan.kind} x{mag:g} into update {step}"
+    )
+    if _plan.kind == "loss-spike":
+        return mag, 1.0
+    return 1.0, mag
 
 
 def maybe_perturb_geometry(step: int, samples: List):

@@ -171,7 +171,8 @@ def _short_hash(obj) -> Optional[str]:
 
 # comparison order: the earliest divergent field is the diagnosis, so the
 # most causally-upstream fields come first (a config skew explains a seed
-# skew explains a geometry skew)
+# skew explains a geometry skew; a divergent sentinel recovery history is
+# the most downstream symptom of all)
 _FIELD_ORDER = (
     "config",
     "seed",
@@ -180,6 +181,7 @@ _FIELD_ORDER = (
     "loss_scale",
     "batch_sig",
     "dummy_plan",
+    "sentinel",
 )
 
 _FINGERPRINT_TAG = "unicore-tpu-consistency-v1"
@@ -215,6 +217,10 @@ class ConsistencyGuard:
         from unicore_tpu.distributed import chaos
 
         step = int(trainer.get_num_updates())
+        # THIS trainer's sentinel, not a process-global lookup: an
+        # in-process sweep driver constructs several trainers, and the
+        # fingerprint must describe the run being checked
+        sentinel = getattr(trainer, "sentinel", None)
         return {
             "config": self.digest,
             "seed": chaos.maybe_skew_seed(step, self.seed),
@@ -223,6 +229,12 @@ class ConsistencyGuard:
             "loss_scale": getattr(trainer, "current_loss_scale", lambda: None)(),
             "batch_sig": self._last_batch_sig_hash,
             "dummy_plan": self._last_plan_hash,
+            # health-sentinel recovery history (event count, rewind count,
+            # last rewind step): hosts that silently recovered differently
+            # are named here even if their params re-converged
+            "sentinel": (
+                sentinel.fingerprint_token() if sentinel is not None else None
+            ),
         }
 
     def maybe_check(self, trainer) -> None:

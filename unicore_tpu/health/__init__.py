@@ -1,0 +1,29 @@
+"""Training-health sentinel: loss-spike detection with automatic
+in-memory rewind and data skip-ahead (docs/robustness.md, "Training-health
+sentinel").
+
+- :mod:`~unicore_tpu.health.detectors` — streaming anomaly detectors
+  (EMA-band loss spikes, grad-norm explosion, loss-scale collapse);
+- :mod:`~unicore_tpu.health.snapshot` — async device->host state copies
+  and the bounded rewind ring;
+- :mod:`~unicore_tpu.health.sentinel` — the recovery policy (escalation
+  ladder, cross-host agreement, checkpointed event history).
+"""
+
+from unicore_tpu.health.detectors import (  # noqa: F401
+    Anomaly,
+    GradNormExplosionDetector,
+    LossScaleCollapseDetector,
+    LossSpikeDetector,
+)
+from unicore_tpu.health.sentinel import (  # noqa: F401
+    TrainingHealthError,
+    TrainingHealthSentinel,
+    build_sentinel,
+)
+from unicore_tpu.health.snapshot import (  # noqa: F401
+    HealthSnapshot,
+    SnapshotRing,
+    host_copy_tree,
+    device_restore_tree,
+)

@@ -1,0 +1,166 @@
+"""Host-RAM rewind snapshots: async device->host copies + a bounded ring.
+
+The sentinel's rewind needs a recent, CLEAN copy of the full TrainState
+(params, optimizer state, EMA, loss-scale scalars) that survives the
+anomalous updates that follow it — without an on-disk checkpoint round
+trip.  Two pieces:
+
+- :func:`host_copy_tree` / :func:`device_restore_tree` — a pytree-wide
+  device->host copy that (a) INITIATES every leaf's DMA before completing
+  any (``copy_to_host_async``), so transfers overlap instead of
+  serializing leaf by leaf, and (b) copies per-SHARD for arrays that are
+  not fully addressable (multi-host TP / ZeRO-1 state): each process
+  keeps exactly its own shard blocks, deduplicated by global index, and
+  the restore reassembles them under the trainer's sharding tree via
+  ``jax.make_array_from_callback``.  Replicated leaves cost one host copy,
+  never one per device.
+- :class:`SnapshotRing` — the last ``keep`` snapshots, oldest evicted
+  first; ``newest_at_or_before(step)`` picks the rewind target and
+  ``drop_newer_than(step)`` discards snapshots from an abandoned
+  (post-anomaly) trajectory after a rewind.
+
+Donation safety: the copy is initiated AND completed inside the same
+call, strictly between two train-step dispatches — so even with
+``--donate-train-state`` the source buffers cannot be invalidated while
+a DMA is still in flight.
+"""
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _HostShards:
+    """Host copy of a non-fully-addressable array: this process's shard
+    blocks keyed by global index (deduplicated across local replicas)."""
+
+    __slots__ = ("shape", "dtype", "blocks")
+
+    def __init__(self, shape, dtype, blocks: Dict[str, np.ndarray]):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.blocks = blocks  # str(global index) -> host block
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self.blocks.values())
+
+
+def host_copy_tree(tree):
+    """Copy a device pytree to host RAM (see module docstring)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    # pass 1: kick off every device->host DMA before blocking on any
+    for leaf in leaves:
+        try:
+            if getattr(leaf, "is_fully_addressable", True):
+                leaf.copy_to_host_async()
+            else:
+                for s in leaf.addressable_shards:
+                    s.data.copy_to_host_async()
+        except AttributeError:
+            pass  # plain numpy / python scalars have nothing to prefetch
+
+    # pass 2: materialize
+    def materialize(leaf):
+        if not hasattr(leaf, "addressable_shards"):
+            return np.asarray(leaf)
+        if getattr(leaf, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(leaf))
+        blocks: Dict[str, np.ndarray] = {}
+        for s in leaf.addressable_shards:
+            key = str(s.index)
+            if key not in blocks:  # replicas of the same block: keep one
+                blocks[key] = np.asarray(s.data)
+        return _HostShards(leaf.shape, leaf.dtype, blocks)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [materialize(l) for l in leaves]
+    )
+
+
+def device_restore_tree(host_tree, shardings_tree):
+    """Place a :func:`host_copy_tree` result back on device under the
+    trainer's sharding tree (the inverse operation)."""
+    import jax
+
+    def restore(leaf, sharding):
+        if isinstance(leaf, _HostShards):
+            return jax.make_array_from_callback(
+                leaf.shape, sharding, lambda idx: leaf.blocks[str(idx)]
+            )
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(restore, host_tree, shardings_tree)
+
+
+def tree_nbytes(host_tree) -> int:
+    import jax
+
+    return sum(
+        getattr(l, "nbytes", 0)
+        for l in jax.tree_util.tree_leaves(host_tree)
+    )
+
+
+@dataclass
+class HealthSnapshot:
+    """One rewind point: everything needed to put the run back at
+    ``step`` in memory (the data iterator is deliberately NOT rewound —
+    recovery skips FORWARD past the offending window instead, so the
+    snapshot's iterator position is a record, not a restore target)."""
+
+    step: int                      # num_updates the state corresponds to
+    state: Any                     # host copy of the full TrainState
+    lr_sched_state: Optional[dict] = None
+    iterator_state: Optional[dict] = None
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def nbytes(self) -> int:
+        return tree_nbytes(self.state)
+
+
+class SnapshotRing:
+    """Bounded ring of :class:`HealthSnapshot`, oldest evicted first."""
+
+    def __init__(self, keep: int):
+        self.keep = max(int(keep), 1)
+        self._ring: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def steps(self) -> List[int]:
+        return [s.step for s in self._ring]
+
+    def add(self, snap: HealthSnapshot) -> None:
+        while len(self._ring) >= self.keep:
+            evicted = self._ring.popleft()  # oldest first
+            logger.debug(f"snapshot ring: evicted rewind point @{evicted.step}")
+        self._ring.append(snap)
+
+    def newest_at_or_before(self, step: int) -> Optional[HealthSnapshot]:
+        """The rewind target: the newest snapshot taken at or before
+        ``step`` (i.e. strictly before the anomaly window opened)."""
+        best = None
+        for snap in self._ring:
+            if snap.step <= step and (best is None or snap.step > best.step):
+                best = snap
+        return best
+
+    def drop_newer_than(self, step: int) -> int:
+        """Discard snapshots from the abandoned trajectory after a rewind
+        to ``step``; returns how many were dropped."""
+        before = len(self._ring)
+        self._ring = deque(s for s in self._ring if s.step <= step)
+        return before - len(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()

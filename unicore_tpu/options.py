@@ -77,6 +77,7 @@ def get_training_parser(default_task=None):
     add_model_args(parser)
     add_optimization_args(parser)
     add_checkpoint_args(parser)
+    add_training_health_args(parser)
     return parser
 
 
@@ -387,7 +388,77 @@ def add_distributed_training_args(parser, default_world_size=None):
                        help="chaos harness (distributed/chaos.py): inject "
                             "seed-skew, geometry-skew, collective-delay, "
                             "truncate-checkpoint, or raise at STEP on RANK "
-                            "(default: last rank) to prove the guards fire")
+                            "(default: last rank) to prove the guards fire; "
+                            "loss-spike[:MAGNITUDE] and "
+                            "grad-explosion[:SCALE] fire on EVERY rank at "
+                            "exactly STEP (once) to prove the training-"
+                            "health sentinel detects, rewinds, and heals")
+    return group
+
+
+def add_training_health_args(parser):
+    """Training-health sentinel (unicore_tpu/health/, docs/robustness.md):
+    loss-spike / grad-explosion / loss-scale-collapse detection with
+    automatic in-memory rewind and data skip-ahead."""
+    group = parser.add_argument_group("training_health")
+    group.add_argument("--sentinel-interval", type=int, default=0, metavar="N",
+                       help="observe the per-update training metrics (loss, "
+                            "grad norm, loss scale) every N updates and arm "
+                            "the health sentinel's detect-rewind-skip "
+                            "recovery ladder (0 disables the sentinel "
+                            "entirely; 1 = check every update, costs one "
+                            "small lag-1 host fetch per update)")
+    group.add_argument("--snapshot-interval", type=int, default=200,
+                       metavar="N",
+                       help="updates between host-RAM rewind snapshots of "
+                            "the full train state (params, optimizer, EMA, "
+                            "scalars); each costs one bulk device->host "
+                            "copy off the hot path (0 disables snapshots — "
+                            "an anomaly then escalates straight to abort)")
+    group.add_argument("--snapshot-keep", type=int, default=2, metavar="K",
+                       help="host-RAM snapshot ring size (oldest evicted "
+                            "first); RAM cost is K x the train state size")
+    group.add_argument("--sentinel-warmup", type=int, default=50, metavar="N",
+                       help="grace period: no anomaly is ever flagged in "
+                            "the first N updates (early training is "
+                            "legitimately wild)")
+    group.add_argument("--loss-spike-zmax", type=float, default=6.0,
+                       metavar="Z",
+                       help="flag a loss sitting more than Z standard "
+                            "deviations above its EMA band as a spike")
+    group.add_argument("--loss-spike-window", type=int, default=64,
+                       metavar="N",
+                       help="EMA window (in observations) for the loss and "
+                            "grad-norm streaming statistics")
+    group.add_argument("--gnorm-explosion-factor", type=float, default=10.0,
+                       metavar="F",
+                       help="flag a pre-clip grad norm above F times its "
+                            "EMA mean as an explosion")
+    group.add_argument("--scale-collapse-halvings", type=int, default=8,
+                       metavar="N",
+                       help="fp16 only: flag N consecutive downward loss-"
+                            "scale rescales with no recovery in between as "
+                            "a collapse")
+    group.add_argument("--spike-skip-updates", type=int, default=2,
+                       metavar="N",
+                       help="after a rewind, fast-forward the data iterator "
+                            "N extra update-chunks past the offending "
+                            "window (the stall budget is relaxed x10 for "
+                            "the skip)")
+    group.add_argument("--spike-cooldown-updates", type=int, default=100,
+                       metavar="N",
+                       help="a repeat anomaly within N updates of the last "
+                            "rewind escalates to rewind + lr cooldown for "
+                            "N updates; a clean cooldown de-escalates the "
+                            "ladder")
+    group.add_argument("--spike-cooldown-factor", type=float, default=0.1,
+                       metavar="F",
+                       help="lr multiplier applied during a post-rewind "
+                            "cooldown window")
+    group.add_argument("--max-rewinds", type=int, default=3, metavar="N",
+                       help="abort with a diagnosis (detector, step, "
+                            "statistic) once N rewinds have been spent "
+                            "without the run stabilizing")
     return group
 
 

@@ -44,7 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from unicore_tpu import checkpoint_utils, utils
+from unicore_tpu import checkpoint_utils, health, utils
 from unicore_tpu.distributed import chaos, guard
 from unicore_tpu.distributed import utils as distributed_utils
 from unicore_tpu.ema import ema_to_model_dtype, init_ema, update_ema
@@ -145,6 +145,11 @@ class Trainer(object):
         guard.configure(args)
         chaos.configure(args)
         self.guard = guard.ConsistencyGuard(args)
+        # training-health sentinel (unicore_tpu/health/): loss-spike /
+        # grad-explosion / scale-collapse detection with in-memory rewind;
+        # None unless --sentinel-interval > 0.  The consistency guard
+        # fingerprints its recovery history via trainer.sentinel.
+        self.sentinel = health.build_sentinel(args)
 
         metrics.log_start_time("wall", priority=790, round=2)
 
@@ -386,12 +391,24 @@ class Trainer(object):
         }
         return grads, sample_size, logging_output
 
-    def _apply_update(self, state, grads, sample_size, logging_output, lr, rng):
-        """Normalize, clip, (maybe) skip, update, EMA — pure."""
+    def _apply_update(self, state, grads, sample_size, logging_output,
+                      scalars, rng):
+        """Normalize, clip, (maybe) skip, update, EMA — pure.  ``scalars``
+        carries the lr plus the chaos fault multipliers (both 1.0 outside
+        an armed ``loss-spike``/``grad-explosion`` trigger step)."""
+        lr = scalars["lr"]
         loss_scale = state["loss_scale"]
+        # chaos loss-spike / grad-explosion injection folds into the
+        # normalization denominator (zero extra device work when healthy);
+        # a loss spike also scales the REPORTED loss so the sentinel's
+        # loss band sees exactly what a real divergence would show it
+        fault_mul = scalars["loss_mul"] * scalars["grad_mul"]
         with jax.named_scope("multiply-grads"):
-            denom = jnp.maximum(sample_size, 1e-8) * loss_scale
+            denom = jnp.maximum(sample_size, 1e-8) * loss_scale / fault_mul
             grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+        if "loss" in logging_output:
+            logging_output = dict(logging_output)
+            logging_output["loss"] = logging_output["loss"] * scalars["loss_mul"]
 
         clip_norm = getattr(self.args, "clip_norm", 0.0) or 0.0
         with jax.named_scope("clip-grads"):
@@ -453,6 +470,11 @@ class Trainer(object):
                 "gnorm": gnorm,
                 "loss_scale": loss_scale,
                 "overflow": overflow.astype(jnp.float32),
+                # NaN (unlike inf) survives any loss-scale change, so a NaN
+                # gnorm is a GENUINE bad gradient, not a scale overflow —
+                # the distinction --nan-rerun localization keys on under
+                # fp16 dynamic scaling
+                "nan_grads": jnp.isnan(gnorm).astype(jnp.float32),
                 "min_scale_pinned": pinned.astype(jnp.float32),
                 "clip": (
                     (gnorm > clip_norm).astype(jnp.float32)
@@ -511,8 +533,7 @@ class Trainer(object):
                         scalars["weight"],
                     )
                 new_state, step_metrics = self._apply_update(
-                    state, grads, sample_size, logging_output,
-                    scalars["lr"], rng,
+                    state, grads, sample_size, logging_output, scalars, rng,
                 )
                 return new_state, accumulate(macc, step_metrics)
 
@@ -564,7 +585,7 @@ class Trainer(object):
                     )
                 rng = make_rng(scalars, 0)
                 new_state, step_metrics = self._apply_update(
-                    state, grads, ss, log, scalars["lr"], rng
+                    state, grads, ss, log, scalars, rng
                 )
                 return new_state, accumulate(macc, step_metrics)
 
@@ -596,8 +617,7 @@ class Trainer(object):
                 rng = make_rng(scalars, 0)
                 grads, sample_size, logging_output = acc
                 new_state, step_metrics = self._apply_update(
-                    state, grads, sample_size, logging_output,
-                    scalars["lr"], rng,
+                    state, grads, sample_size, logging_output, scalars, rng,
                 )
                 return new_state, accumulate(macc, step_metrics)
 
@@ -630,8 +650,18 @@ class Trainer(object):
         """Small host->device scalar bundle for one step; everything else
         (rng folding, lr math) happens inside the compiled step."""
         step = self.get_num_updates()
+        lr = self.get_lr()
+        if self.sentinel is not None:
+            # post-rewind lr cooldown (escalation ladder level 2); 1.0
+            # outside an active cooldown window
+            lr = lr * self.sentinel.lr_scale(step)
+        # chaos loss-spike / grad-explosion multipliers (1.0 when unarmed);
+        # identical on every host — these feed replicated jit inputs
+        loss_mul, grad_mul = chaos.fault_multipliers(step)
         return {
-            "lr": np.float32(self.get_lr()),
+            "lr": np.float32(lr),
+            "loss_mul": np.float32(loss_mul),
+            "grad_mul": np.float32(grad_mul),
             # chaos seed-skew routes through here so the injected desync is
             # exactly the one the consistency guard's 'seed' field catches
             "seed": np.int32(
@@ -763,11 +793,17 @@ class Trainer(object):
         guard.note_step(self.get_num_updates())
         self.guard.maybe_check(self)
 
-        if getattr(self.args, "nan_rerun", False) and not self.use_loss_scale:
+        if getattr(self.args, "nan_rerun", False):
             # opt-in reference parity (trainer.py:727-748): pay one host
             # sync per step; on a fresh non-finite gradient, localize it by
-            # re-running this batch under the NaN detector, then abort
-            seen = float(jax.device_get(self._macc["overflow"]))
+            # re-running this batch under the NaN detector, then abort.
+            # Under fp16 dynamic scaling, inf gradients are ROUTINE scale
+            # overflows (the schedule shrinks the scale and retries), so
+            # localization keys on the NaN count — NaN survives any
+            # rescale, so it is a genuine bad gradient even with scaling
+            # on.  Without scaling, any non-finite gradient is genuine.
+            key = "nan_grads" if self.use_loss_scale else "overflow"
+            seen = float(jax.device_get(self._macc[key]))
             if seen > self._nan_rerun_seen:
                 self._nan_rerun_seen = seen
                 detail = self._localize_nan(samples)
@@ -839,7 +875,18 @@ class Trainer(object):
         loss_scale_sum = delta.pop("loss_scale", None)
         clip_cnt = delta.pop("clip", 0.0)
         overflow_cnt = delta.pop("overflow", 0.0)
+        nan_cnt = delta.pop("nan_grads", 0.0)
         pinned_cnt = delta.pop("min_scale_pinned", 0.0)
+        if nan_cnt > 0 and self.use_loss_scale:
+            # under dynamic scaling inf overflows are routine, but NaN is
+            # not scale-fixable: surface it even though the skip machinery
+            # quietly absorbed the update
+            logger.warning(
+                f"{int(nan_cnt)} update(s) in the last interval had NaN "
+                "gradients — NOT a loss-scale overflow (NaN survives "
+                "rescaling); rerun with --nan-rerun or --debug-nans to "
+                "localize the source"
+            )
         if pinned_cnt > 0:
             # the in-jit schedule pinned at min_loss_scale while still
             # overflowing — the reference aborts training here
@@ -883,6 +930,57 @@ class Trainer(object):
                 gb_free = (stats["bytes_limit"] - stats["bytes_in_use"]) / 1024 ** 3
                 metrics.log_scalar("gb_free", gb_free, weight=0, priority=1500, round=1)
         self.task.reduce_metrics([delta], self.loss)
+
+    # ------------------------------------------------------------------
+    # training-health sentinel hooks (unicore_tpu/health/)
+    # ------------------------------------------------------------------
+
+    def health_check(self, epoch_itr=None, update_itr=None):
+        """Per-update sentinel tick, called by the CLI right after
+        ``train_step`` (before the log-interval flush, so the device-side
+        sums still include this update).  Observes the lag-1 metrics,
+        applies the recovery ladder on a confirmed anomaly (rewinding
+        this trainer and fast-forwarding ``update_itr``), and captures
+        host-RAM rewind snapshots on the configured cadence."""
+        if self.sentinel is None:
+            return
+        self.sentinel.after_update(self, epoch_itr, update_itr)
+
+    def capture_health_snapshot(self, epoch_itr=None):
+        """Host-RAM rewind point: the full TrainState (async-initiated
+        device->host copy, per-shard for non-addressable leaves), the lr
+        scheduler state, and the data-iterator position (recorded for the
+        event log — recovery skips forward, it never rewinds data)."""
+        if self._state is None:
+            return None
+        import copy
+
+        return health.HealthSnapshot(
+            step=self.get_num_updates(),
+            state=health.host_copy_tree(self._state),
+            lr_sched_state=copy.deepcopy(self._lr_scheduler.state_dict()),
+            iterator_state=(
+                epoch_itr.state_dict() if epoch_itr is not None else None
+            ),
+        )
+
+    def restore_health_snapshot(self, snap):
+        """Put the run back at ``snap.step`` in memory: TrainState under
+        its current shardings, lr scheduler, update counter.  The metric
+        accumulator is dropped (its sums describe the abandoned
+        trajectory) and cached eval params are invalidated."""
+        shardings = self._state_shardings(self._state)
+        self._state = health.device_restore_tree(snap.state, shardings)
+        self._cached_eval_params = None
+        self._macc = None
+        self._nan_rerun_seen = 0.0
+        if snap.lr_sched_state is not None:
+            import copy
+
+            self._lr_scheduler.load_state_dict(
+                copy.deepcopy(snap.lr_sched_state)
+            )
+        self.set_num_updates(snap.step)
 
     def valid_step(self, sample, seed=None, accumulate=False):
         """Forward in eval mode (reference trainer.py:804-848).
@@ -1311,6 +1409,9 @@ class Trainer(object):
             "extra_state": {
                 "metrics": metrics.state_dict(),
                 "previous_training_time": self.cumulative_training_time(),
+                "sentinel": self.sentinel.state_dict()
+                if self.sentinel is not None
+                else None,
                 **extra_state,
             },
         }
@@ -1399,6 +1500,12 @@ class Trainer(object):
                 "loss_scale": float(jax.device_get(self._state["loss_scale"]))
                 if self._state is not None
                 else None,
+                # sentinel recovery history: which detectors fired, when,
+                # and what was done — survives restarts so an operator
+                # (and the next run's sentinel) can see the run healed
+                "sentinel": self.sentinel.state_dict()
+                if self.sentinel is not None
+                else None,
             },
         }
         if self.use_ema and self._state is not None and "ema" in self._state:
@@ -1484,6 +1591,12 @@ class Trainer(object):
                     "previous_training_time", 0
                 )
                 self._start_time = time.time()
+                if self.sentinel is not None:
+                    # recovery history carries across restarts (the event
+                    # log is append-only; counts resume where they left)
+                    self.sentinel.load_state_dict(
+                        extra_state.get("sentinel")
+                    )
 
             logger.info(
                 f"Loaded checkpoint {filename} (epoch "

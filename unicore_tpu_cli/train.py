@@ -345,6 +345,13 @@ def train_epoch(args, session, epoch_itr):
         for grouped_samples in progress:
             with metrics.aggregate("train_inner"):
                 step_ok = trainer.train_step(grouped_samples) is not None
+                # training-health sentinel tick (no-op unless
+                # --sentinel-interval > 0): observe this update's metrics,
+                # rewind + fast-forward `itr` on a confirmed anomaly, and
+                # capture rewind snapshots on the --snapshot-interval
+                # cadence.  Before flush_metrics so the device-side sums
+                # still include this update.
+                trainer.health_check(epoch_itr, itr)
                 num_updates = trainer.get_num_updates()
                 at_log_point = num_updates % args.log_interval == 0
                 if at_log_point:
