@@ -92,11 +92,37 @@ def _backend_watchdog(probe_timeout_s=120, total_budget_s=900):
             f"{max(0, int(deadline - time.monotonic()))}s of budget left\n"
         )
         time.sleep(min(30, max(0, deadline - time.monotonic())))
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        # the CPU fallback ALSO failed to bring up a backend — give up for
+        # real (rc=3 keeps the old contract for genuinely broken hosts)
+        sys.stderr.write("bench: CPU fallback backend not ready; aborting\n")
+        os._exit(3)
+    # Accelerator unreachable after the whole retry budget: re-exec as a
+    # small CPU run instead of exiting rc=3 with no numbers — an empty
+    # BENCH_r0*.json leaves the perf trajectory blind, while a CPU row
+    # (labeled "device_kind": "cpu", never a perf claim) at least proves
+    # the training path executes end to end.  A fresh process is the only
+    # safe way to switch platforms: this one may hold a wedged backend
+    # probe thread inside jax's init lock.
     sys.stderr.write(
         f"bench: accelerator backend not ready after {total_budget_s}s "
-        "(tunnel down?); aborting\n"
+        "(tunnel down?); falling back to a small JAX_PLATFORMS=cpu run\n"
     )
-    os._exit(3)
+    env = dict(os.environ)
+    env["BENCH_CPU_FALLBACK"] = "1"
+    env["UNICORE_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    # shrink the workload unless the operator pinned one: CPU exists to
+    # prove liveness, not to grind BERT-base at seq 512 for an hour
+    env.setdefault("BENCH_BATCH", "4")
+    env.setdefault("BENCH_SEQ", "128")
+    env.setdefault("BENCH_TRACE", "0")
+    sys.stderr.flush()
+    os.execve(
+        sys.executable,
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+        env,
+    )
 
 
 def _make_args():
@@ -311,6 +337,8 @@ def _finish_result(result, trainer, sample, dt_per_step):
     so the caller appends the raw number FIRST and everything in here is
     guarded — diagnostics must never lose a measured result."""
     result["ms_per_step"] = round(dt_per_step * 1000, 2)
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        result["cpu_fallback"] = True  # liveness proof, not a perf claim
     try:
         import jax
 

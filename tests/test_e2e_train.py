@@ -7,6 +7,10 @@ import sys
 
 import pytest
 
+# subprocess e2e: out of the tier-1 time budget (see conftest marker docs);
+# CI's smoke job and `pytest -m slow` run these
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RUNNER = r"""

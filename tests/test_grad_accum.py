@@ -39,7 +39,7 @@ def run(force_seq):
     tr.init_state(mk(1))
     if force_seq:
         tr._try_stack_microbatches = (
-            lambda samples, modes=None: None  # force micro-step path
+            lambda *a, **kw: None  # force micro-step path
         )
     tr.train_step([mk(1), mk(2)])
     leaf = jax.tree_util.tree_leaves(tr._state["params"])[0]

@@ -528,6 +528,7 @@ def _drain(procs, timeout=240):
     return outs
 
 
+@pytest.mark.slow
 def test_two_process_seed_skew_and_geometry_skew_name_rank_and_field():
     """Acceptance: injecting a seed skew (then a geometry skew) on rank 1
     fails fast on BOTH hosts with a diagnosis naming rank 1 and the
@@ -600,6 +601,7 @@ _os._exit(0)
 """
 
 
+@pytest.mark.slow
 def test_two_process_corrupt_fallback_stays_in_lockstep():
     """Code-review finding: a checkpoint torn on ONE host must drag EVERY
     host to the same agreed fallback — never a divergent resume where rank
@@ -639,6 +641,7 @@ else:
 """
 
 
+@pytest.mark.slow
 def test_two_process_stalled_collective_raises_through_watchdog():
     """Companion acceptance test: rank 1 stalls inside the collective; rank
     0's watchdog converts the hang into a CollectiveTimeoutError naming the

@@ -567,6 +567,7 @@ def _sentinel_cli_args(data_dir, save_dir, max_update):
     ]
 
 
+@pytest.mark.slow
 def test_cli_loss_spike_rewinds_and_finishes(data_dir, tmp_path):
     """Acceptance (single-host half): with --fault-inject loss-spike@6 the
     sentinel detects within the lag-1 window, rewinds to a pre-spike
@@ -591,6 +592,7 @@ def test_cli_loss_spike_rewinds_and_finishes(data_dir, tmp_path):
     assert len(events) == 1 and events[0]["detector"] == "loss-spike"
 
 
+@pytest.mark.slow
 def test_cli_sentinel_quiet_on_healthy_run(data_dir, tmp_path):
     """Acceptance (control arm): the identical run minus --fault-inject
     triggers ZERO sentinel events."""
@@ -770,6 +772,7 @@ def _drain(procs, timeout=420):
     return outs
 
 
+@pytest.mark.slow
 def test_two_process_loss_spike_rewind_in_lockstep():
     """Acceptance: on a real 2-process cluster, an injected loss spike at
     step 6 is detected within the lag-1 window, BOTH hosts agree on and

@@ -768,3 +768,100 @@ def test_module_entry_point_subprocess():
         timeout=300,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# sync-transfer-in-step
+# ---------------------------------------------------------------------------
+
+
+def test_sync_transfer_device_get_in_train_step(tmp_path):
+    """jax.device_get directly inside train_step blocks the training
+    thread between dispatches (positive fixture 1)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def train_step(self, samples):
+            out = self._dispatch(samples)
+            return float(jax.device_get(out)["loss"])
+        """,
+        select=["sync-transfer-in-step"],
+    )
+    assert rule_names(vs) == ["sync-transfer-in-step"]
+    assert "jax.device_get" in vs[0].message
+    assert "train_step" in vs[0].message
+
+
+def test_sync_transfer_reachable_helper_chain(tmp_path):
+    """A bare jax.device_put and a .block_until_ready() in helpers REACHED
+    from train_step are both caught — the transfer doesn't have to be
+    lexically inside the step (positive fixture 2)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def _stage(batch):
+            return jax.device_put(batch)
+
+        def _drain(state):
+            state.block_until_ready()
+
+        def _prepare(samples):
+            staged = [_stage(s) for s in samples]
+            return staged
+
+        def train_step(self, samples):
+            staged = _prepare(samples)
+            out = self.step(staged)
+            _drain(out)
+            return out
+        """,
+        select=["sync-transfer-in-step"],
+    )
+    assert rule_names(vs) == ["sync-transfer-in-step"] * 2
+    joined = " ".join(v.message for v in vs)
+    assert "jax.device_put" in joined
+    assert ".block_until_ready()" in joined
+
+
+def test_sync_transfer_negative_unreachable_and_annotated(tmp_path):
+    """Transfers NOT reachable from train_step (checkpoint/eval paths) are
+    fine, and an annotated opt-in sync (e.g. the --nan-rerun fetch) is
+    suppressed by '# lint: explicit-sync' (negative fixture)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+
+        def save_checkpoint(state, path):
+            host = jax.device_get(state)  # not on the train path
+            return host
+
+        def train_step(self, samples):
+            out = self.step(samples)
+            if self.nan_rerun:
+                seen = jax.device_get(self._macc)  # lint: explicit-sync
+                self._check(seen)
+            return out
+        """,
+        select=["sync-transfer-in-step"],
+    )
+    assert vs == []
+
+
+def test_sync_transfer_negative_prefetcher_home(tmp_path):
+    """data/prefetch.py is the sanctioned home for transfers — its whole
+    job is issuing them off the hot thread (negative fixture 2)."""
+    home = tmp_path / "data"
+    home.mkdir()
+    (home / "prefetch.py").write_text(
+        "import jax\n\n"
+        "def train_step(batch):\n"
+        "    return jax.device_put(batch)\n"
+    )
+    vs = lint_paths([str(home / "prefetch.py")],
+                    rules=build_rules(["sync-transfer-in-step"]))
+    assert vs == []

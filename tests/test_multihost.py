@@ -8,6 +8,10 @@ import os
 
 import pytest
 
+# two-real-process subprocess tests: out of the tier-1 time budget (see
+# conftest marker docs); CI's smoke job and `pytest -m slow` run these
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -706,7 +706,91 @@ class PrngKeyReuse(LintRule):
 
 
 # ---------------------------------------------------------------------------
-# 6. untimed-collective
+# 6. sync-transfer-in-step
+# ---------------------------------------------------------------------------
+
+# the one module whose JOB is moving batches to the device off the hot
+# thread — its transfers are the point, not a violation
+_PREFETCH_HOME = os.path.join("data", "prefetch.py")
+
+
+@register_lint_rule("sync-transfer-in-step")
+class SyncTransferInStep(LintRule):
+    name = "sync-transfer-in-step"
+    justifications = ("explicit-sync",)
+    description = (
+        "blocking host<->device synchronization (jax.device_get, "
+        ".block_until_ready(), bare jax.device_put) reachable from "
+        "train_step: each one stalls the training thread between "
+        "dispatches, defeating the device prefetcher — route transfers "
+        "through data/prefetch.py or justify the sync with "
+        "'# lint: explicit-sync' (e.g. the opt-in --nan-rerun fetch)"
+    )
+
+    #: call shapes that block the training thread on the device
+    _TRANSFER_ATTRS = frozenset({"device_get", "device_put"})
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        norm = os.path.normpath(module.path)
+        if norm == _PREFETCH_HOME or norm.endswith(os.sep + _PREFETCH_HOME):
+            return
+        # index every function/method definition by name; reachability is
+        # resolved by terminal callee name (self.foo() and foo() both hit
+        # 'foo'), which is exact for this codebase's method-call idiom
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        roots = defs.get("train_step", [])
+        if not roots:
+            return
+        reachable, seen = [], set()
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            reachable.append(fn)
+            for node in walk_body(fn):
+                if isinstance(node, ast.Call):
+                    callee = terminal_name(node.func)
+                    stack.extend(defs.get(callee, ()))
+        for fn in reachable:
+            for node in walk_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._blocking_transfer(module, node)
+                if hit:
+                    yield _v(
+                        self,
+                        module,
+                        node,
+                        f"{hit} in '{fn.name}', reachable from train_step: "
+                        "the training thread blocks on the device between "
+                        "dispatches — move the transfer into the device "
+                        "prefetcher (data/prefetch.py) or justify it with "
+                        "'# lint: explicit-sync'",
+                    )
+
+    def _blocking_transfer(
+        self, module: ModuleInfo, call: ast.Call
+    ) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                return ".block_until_ready()"
+            if (
+                func.attr in self._TRANSFER_ATTRS
+                and isinstance(func.value, ast.Name)
+                and module.aliases.is_jax(func.value.id)
+            ):
+                return f"{func.value.id}.{func.attr}(...)"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# 7. untimed-collective
 # ---------------------------------------------------------------------------
 
 # the raw jax.experimental.multihost_utils entry points every host-side

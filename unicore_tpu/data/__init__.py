@@ -38,6 +38,7 @@ from .iterators import (
     GroupedIterator,
     ShardedIterator,
 )
+from .prefetch import DevicePrefetcher, PreparedUpdate, RawUpdate
 
 __all__ = [
     "AppendTokenDataset",

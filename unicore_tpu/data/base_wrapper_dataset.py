@@ -39,6 +39,9 @@ class BaseWrapperDataset(UnicoreDataset):
     def ordered_indices(self):
         return self.dataset.ordered_indices()
 
+    def ordered_sizes(self):
+        return self.dataset.ordered_sizes()
+
     # prefetch
     @property
     def supports_prefetch(self):

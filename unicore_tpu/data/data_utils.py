@@ -29,19 +29,77 @@ def pad_to_multiple_size(size: int, multiple: int) -> int:
     return (size // multiple + 1) * multiple
 
 
+# ---------------------------------------------------------------------------
+# length bucketing (--length-bucket; docs/performance.md)
+#
+# Padding only to a multiple still yields as many distinct sequence lengths
+# as the corpus length distribution provides — and every distinct (batch,
+# seqlen) geometry is one more compiled XLA train-step program.  Bucketing
+# pads each batch up to a SMALL FIXED SET of lengths, so the number of
+# compiled programs is bounded by the bucket count instead.
+# ---------------------------------------------------------------------------
+
+def compute_length_buckets(num_buckets, max_len, multiple=1, sizes=None):
+    """A small fixed set of padded sequence lengths covering ``max_len``.
+
+    With per-sample ``sizes`` available, edges sit at quantiles of the
+    length distribution (minimal average padding waste); without them,
+    edges are evenly spaced.  Every edge is rounded up to ``multiple`` and
+    the last edge always covers ``max_len``; duplicates collapse, so the
+    result may hold fewer than ``num_buckets`` entries.  Returns None when
+    bucketing is off (``num_buckets <= 0``)."""
+    num_buckets = int(num_buckets or 0)
+    if num_buckets <= 0:
+        return None
+    top = pad_to_multiple_size(int(max_len), multiple)
+    if num_buckets == 1:
+        return (top,)
+    if sizes is not None and len(sizes):
+        qs = np.quantile(
+            np.asarray(sizes, dtype=np.float64),
+            np.linspace(1.0 / num_buckets, 1.0, num_buckets),
+        )
+        edges = [pad_to_multiple_size(int(np.ceil(q)), multiple) for q in qs]
+    else:
+        step = max_len / float(num_buckets)
+        edges = [
+            pad_to_multiple_size(int(np.ceil(step * (i + 1))), multiple)
+            for i in range(num_buckets)
+        ]
+    edges = [min(max(e, multiple), top) for e in edges]
+    edges[-1] = top
+    return tuple(sorted(set(edges)))
+
+
+def bucket_for(size: int, buckets) -> int:
+    """Smallest bucket edge >= ``size``, or None when ``size`` overflows
+    every bucket (callers fall back to plain multiple-rounding — graceful,
+    but each overflow length costs its own compile)."""
+    if buckets:
+        for edge in buckets:
+            if size <= edge:
+                return edge
+    return None
+
+
 def collate_tokens(
     values: List[np.ndarray],
     pad_idx,
     left_pad=False,
     pad_to_length=None,
     pad_to_multiple=1,
+    pad_to_buckets=None,
 ):
     """Convert a list of 1d arrays into a padded 2d array
-    (reference data_utils.py:17-37)."""
+    (reference data_utils.py:17-37).  ``pad_to_buckets`` (a sorted tuple
+    from :func:`compute_length_buckets`) snaps the padded width up to the
+    smallest covering bucket so batch geometries stay in a fixed set."""
     values = [np.asarray(v) for v in values]
     size = max(v.shape[0] for v in values)
     size = size if pad_to_length is None else max(size, pad_to_length)
     size = pad_to_multiple_size(size, pad_to_multiple)
+    if pad_to_buckets:
+        size = bucket_for(size, pad_to_buckets) or size
     if values[0].dtype == np.int64 and values[0].ndim == 1:
         from . import native
 
@@ -63,6 +121,7 @@ def collate_tokens_2d(
     left_pad=False,
     pad_to_length=None,
     pad_to_multiple=1,
+    pad_to_buckets=None,
 ):
     """Convert a list of 2d (L x L) arrays into a padded square 3d array —
     pairwise features for Uni-Mol/Uni-Fold (reference data_utils.py:40-60)."""
@@ -70,6 +129,8 @@ def collate_tokens_2d(
     size = max(v.shape[0] for v in values)
     size = size if pad_to_length is None else max(size, pad_to_length)
     size = pad_to_multiple_size(size, pad_to_multiple)
+    if pad_to_buckets:
+        size = bucket_for(size, pad_to_buckets) or size
     if not left_pad and values[0].ndim == 2 and values[0].dtype in (
         np.float32, np.int64,
     ):
@@ -124,9 +185,20 @@ def batch_by_size(
     indices,
     batch_size=None,
     required_batch_size_multiple=1,
+    sizes=None,
+    bucket_edges=None,
 ):
     """Chunk ordered indices into fixed-size batches, honoring
     ``required_batch_size_multiple`` (reference data_utils.py:107-139).
+
+    With ``sizes`` (per-index sample lengths) and ``bucket_edges`` (from
+    :func:`compute_length_buckets`), indices are first stable-partitioned
+    by bucket so each batch pads to ITS bucket's edge instead of the
+    longest sample that happened to land in it — the padding-waste half of
+    the --length-bucket policy (the collater's bucket snap is the
+    compile-count half).  Per-bucket remainders are merged into shared
+    tail batches so the whole partition produces at most one odd-sized
+    batch, not one per bucket.
 
     TPU note: fixed batch sizes keep jit shapes static — one compile."""
     batch_size = batch_size if batch_size is not None else 1
@@ -136,6 +208,35 @@ def batch_by_size(
 
     if not isinstance(indices, np.ndarray):
         indices = np.fromiter(indices, dtype=np.int64, count=-1)
+
+    if bucket_edges and sizes is not None and len(indices):
+        sizes = np.asarray(sizes)
+        edges = np.asarray(sorted(bucket_edges))
+        # bucket id per index (lengths beyond the last edge clamp into it)
+        which = np.minimum(
+            np.searchsorted(edges, sizes[indices]), len(edges) - 1
+        )
+        out = []
+        leftover = []
+        for b in range(len(edges)):
+            sub = indices[which == b]  # stable: preserves caller order
+            n_full = (len(sub) // step) * step
+            if n_full:
+                out.extend(batch_by_size(sub[:n_full], batch_size, bsz_mult))
+            if n_full < len(sub):
+                leftover.append(sub[n_full:])
+        if leftover:
+            # per-bucket remainders would each mint a distinct (rows, edge)
+            # geometry — up to one extra compile per bucket, landing after
+            # --compile-warmup-updates once shuffled.  Merging them keeps
+            # full-size batches (they pad to the covering edge of their
+            # longest member, an edge that already has full batches) and
+            # leaves at most ONE odd-sized tail, same as the unbucketed
+            # path.  Concatenation in bucket order keeps lengths ascending,
+            # so merged batches stay as homogeneous as the remainders allow.
+            out.extend(batch_by_size(np.concatenate(leftover), batch_size,
+                                     bsz_mult))
+        return out
 
     num_batches = (len(indices) + step - 1) // step
     steps = np.arange(num_batches - 1) + 1

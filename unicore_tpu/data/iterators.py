@@ -195,6 +195,12 @@ class EpochBatchIterator(EpochBatchIterating):
         self._cur_epoch_itr = None
         self._next_epoch_itr = None
         self._supports_prefetch = getattr(dataset, "supports_prefetch", False)
+        # When a device prefetcher (data/prefetch.py) reads ahead of the
+        # training thread, the raw iterator position runs AHEAD of what was
+        # actually trained; the prefetcher installs itself here so
+        # state_dict()/end_of_epoch() report the CONSUMED position and a
+        # mid-epoch checkpoint resume never skips the buffered updates.
+        self.position_source = None
 
     @property
     def frozen_batches(self):
@@ -239,6 +245,7 @@ class EpochBatchIterator(EpochBatchIterating):
                        set_dataset_epoch=True):
         if self.disable_shuffling:
             shuffle = False
+        self.position_source = None  # stale prefetcher from the last epoch
         self.epoch = self.next_epoch_idx
         if set_dataset_epoch and hasattr(self.dataset, "set_epoch"):
             self.dataset.set_epoch(self.epoch)
@@ -255,10 +262,14 @@ class EpochBatchIterator(EpochBatchIterating):
         return self._cur_epoch_itr
 
     def end_of_epoch(self) -> bool:
+        if self.position_source is not None:
+            return self.position_source.end_of_epoch()
         return not self._cur_epoch_itr.has_next()
 
     @property
     def iterations_in_epoch(self):
+        if self.position_source is not None:
+            return self.position_source.iterations_in_epoch
         for itr in (self._cur_epoch_itr, self._next_epoch_itr):
             if itr is not None:
                 return itr.n

@@ -3,6 +3,12 @@
 Pads to a multiple of 8 — on TPU this aligns the sequence dimension with the
 VPU sublane width and keeps XLA tile shapes friendly (same constant the
 reference uses for tensor-core alignment).
+
+``pad_to_buckets`` (the --length-bucket policy; docs/performance.md) goes
+further: the padded width snaps up to a small fixed set of lengths
+(data_utils.compute_length_buckets), so the number of distinct batch
+geometries — and therefore compiled train-step programs — is bounded by
+the bucket count instead of the corpus length distribution.
 """
 
 from . import data_utils
@@ -10,11 +16,13 @@ from .base_wrapper_dataset import BaseWrapperDataset
 
 
 class PadDataset(BaseWrapperDataset):
-    def __init__(self, dataset, pad_idx, left_pad, pad_to_multiple=8):
+    def __init__(self, dataset, pad_idx, left_pad, pad_to_multiple=8,
+                 pad_to_buckets=None):
         super().__init__(dataset)
         self.pad_idx = pad_idx
         self.left_pad = left_pad
         self.pad_to_multiple = pad_to_multiple
+        self.pad_to_buckets = pad_to_buckets
 
     def collater(self, samples):
         return data_utils.collate_tokens(
@@ -22,6 +30,7 @@ class PadDataset(BaseWrapperDataset):
             self.pad_idx,
             left_pad=self.left_pad,
             pad_to_multiple=self.pad_to_multiple,
+            pad_to_buckets=self.pad_to_buckets,
         )
 
 
@@ -31,17 +40,21 @@ class LeftPadDataset(PadDataset):
 
 
 class RightPadDataset(PadDataset):
-    def __init__(self, dataset, pad_idx, pad_to_multiple=8):
+    def __init__(self, dataset, pad_idx, pad_to_multiple=8,
+                 pad_to_buckets=None):
         super().__init__(dataset, pad_idx, left_pad=False,
-                         pad_to_multiple=pad_to_multiple)
+                         pad_to_multiple=pad_to_multiple,
+                         pad_to_buckets=pad_to_buckets)
 
 
 class RightPadDataset2D(BaseWrapperDataset):
-    def __init__(self, dataset, pad_idx, left_pad=False, pad_to_multiple=8):
+    def __init__(self, dataset, pad_idx, left_pad=False, pad_to_multiple=8,
+                 pad_to_buckets=None):
         super().__init__(dataset)
         self.pad_idx = pad_idx
         self.left_pad = left_pad
         self.pad_to_multiple = pad_to_multiple
+        self.pad_to_buckets = pad_to_buckets
 
     def collater(self, samples):
         return data_utils.collate_tokens_2d(
@@ -49,12 +62,14 @@ class RightPadDataset2D(BaseWrapperDataset):
             self.pad_idx,
             left_pad=self.left_pad,
             pad_to_multiple=self.pad_to_multiple,
+            pad_to_buckets=self.pad_to_buckets,
         )
 
 
 class FixedPadDataset(BaseWrapperDataset):
     """Pad every batch to a fixed length — guarantees ONE jit compilation
-    across the whole run (no reference equivalent; TPU-native addition)."""
+    across the whole run (the single-bucket special case of
+    ``pad_to_buckets``; kept for explicit-length callers)."""
 
     def __init__(self, dataset, pad_idx, pad_length, left_pad=False):
         super().__init__(dataset)

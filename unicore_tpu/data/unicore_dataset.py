@@ -55,6 +55,15 @@ class UnicoreDataset(EpochListening):
         """Index order batches are built from (natural order by default)."""
         return np.arange(len(self), dtype=np.int64)
 
+    def ordered_sizes(self):
+        """Per-index sample lengths as an array, or None when sizes are not
+        cheaply known (e.g. lazily tokenized text).  Datasets that return
+        sizes get --length-bucket's quantile edges and per-bucket batch
+        grouping (see UnicoreTask.length_bucket_edges / batch_by_size);
+        without them bucketing still bounds compile counts via the
+        collater's bucket snap alone."""
+        return None
+
     def attr(self, attr: str, index: int):
         """Per-index attribute lookup; the default ignores the index."""
         return getattr(self, attr, None)
@@ -81,13 +90,21 @@ class UnicoreDataset(EpochListening):
         indices,
         batch_size=None,
         required_batch_size_multiple=1,
+        sizes=None,
+        bucket_edges=None,
     ):
         """Chunk ``indices`` into batches of ``batch_size``, respecting the
-        size multiple (see data_utils.batch_by_size)."""
+        size multiple (see data_utils.batch_by_size).  Datasets that know
+        their per-sample lengths can pass ``sizes`` + ``bucket_edges`` so
+        batches group by length bucket (--length-bucket padding-waste
+        reduction); without them, bucketing still bounds compile counts
+        via the collater's bucket snap alone."""
         from unicore_tpu.data import data_utils
 
         return data_utils.batch_by_size(
             indices,
             batch_size=batch_size,
             required_batch_size_multiple=required_batch_size_multiple,
+            sizes=sizes,
+            bucket_edges=bucket_edges,
         )

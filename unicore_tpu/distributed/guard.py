@@ -117,6 +117,9 @@ _PER_HOST_ARGS = frozenset(
         "tensorboard_logdir",
         "wandb_project",
         "wandb_name",
+        # host-local compile-cache location (the cached programs are
+        # content-addressed; the path itself cannot change the SPMD math)
+        "jax_compilation_cache_dir",
     }
 )
 
@@ -536,7 +539,10 @@ def stop_requested_global() -> Optional[str]:
     a checkpoint.  On multi-host, ONLY the agreed flag counts (a host's
     local flag propagates via the next update's slot-plan gather, so the
     stop lands at most one update late but on EVERY host at the same
-    update).  Single-host returns the local flag directly."""
+    update; under --prefetch-to-device the plan for the next few updates
+    was exchanged at producer read-ahead time, so the bound widens to the
+    prefetch queue depth + 1 updates — budget preemption grace
+    accordingly).  Single-host returns the local flag directly."""
     import jax
 
     if jax.process_count() <= 1:

@@ -237,6 +237,23 @@ def get_parser(desc, default_task=None):
                              "main method can return a value (useful for sweeps)")
     parser.add_argument("--profile", action="store_true",
                         help="enable jax.profiler trace collection during training")
+    parser.add_argument("--jax-compilation-cache-dir", default=None,
+                        metavar="DIR",
+                        help="persistent XLA compilation cache: compiled "
+                             "train-step programs are written here and "
+                             "reloaded on restart, so resumes and repeated "
+                             "runs of the same config skip XLA entirely "
+                             "(per-host local path; safe to share via a "
+                             "network filesystem)")
+    parser.add_argument("--compile-warmup-updates", default=10, type=int,
+                        metavar="N",
+                        help="compile-stability budget: every batch "
+                             "geometry should have been seen within the "
+                             "first N updates; a recompile firing later "
+                             "logs a 'recompile after warmup' WARNING "
+                             "naming the update and program count "
+                             "(0 disables the warning; the 'recompiles' "
+                             "metric is always reported)")
     parser.add_argument("--ema-decay", default=-1.0, type=float,
                         help="enable moving average for model parameters")
     parser.add_argument("--validate-with-ema", action="store_true")
@@ -279,7 +296,36 @@ def add_dataset_args(parser, train=False, gen=False):
     group.add_argument("--required-batch-size-multiple", default=1, type=int, metavar="N",
                        help="batch size will be a multiplier of this value")
     group.add_argument("--data-buffer-size", default=10, type=int, metavar="N",
-                       help="number of batches to preload / double-buffer onto device")
+                       help="number of batches the host-side buffered loader "
+                            "preloads (device read-ahead is --prefetch-depth)")
+    group.add_argument("--prefetch-depth", default=2, type=int, metavar="N",
+                       help="device read-ahead depth for --prefetch-to-device: "
+                            "how many fully-prepared updates may sit in HBM "
+                            "ahead of the consumer (each holds a full global "
+                            "batch; deeper queues also widen the agreed "
+                            "graceful-stop lag to N+1 updates)")
+    group.add_argument("--prefetch-to-device", action="store_true",
+                       help="double-buffered device prefetch "
+                            "(data/prefetch.py): a producer thread narrows/"
+                            "stacks update N+1's micro-batches, runs the "
+                            "slot-plan exchange off the hot thread, and "
+                            "issues the host->device transfer while update "
+                            "N computes, so the training thread's per-"
+                            "update work is one jitted dispatch.  Falls "
+                            "back to the synchronous path for gather/dummy "
+                            "slots, the first update of each epoch, and "
+                            "whenever --fault-inject is armed")
+    group.add_argument("--length-bucket", default=0, type=int, metavar="N",
+                       help="pad each batch's sequence length up into a "
+                            "fixed set of at most N lengths covering "
+                            "--max-seq-len (quantile-spaced with per-bucket "
+                            "batch grouping when the dataset reports "
+                            "per-sample sizes via ordered_sizes(); evenly "
+                            "spaced for lazily-tokenized datasets; always "
+                            "rounded to the pad multiple) so the number of "
+                            "compiled train-step programs is bounded by N "
+                            "instead of the corpus length distribution "
+                            "(0 disables)")
     group.add_argument("--data-stall-timeout", default=0.0, type=float,
                        metavar="SECS",
                        help="escalate the data-pipeline starvation warning: "

@@ -89,11 +89,20 @@ class BertTask(UnicoreTask):
 
     def _padded(self, dataset):
         """Right-pad view with this task's pad token, rounded up to
-        --seq-pad-multiple so every batch lands on kernel-aligned widths."""
+        --seq-pad-multiple so every batch lands on kernel-aligned widths.
+        With --length-bucket N, widths additionally snap up into a fixed
+        set of N lengths covering --max-seq-len, so the whole run compiles
+        at most one train-step program per bucket.  Edges resolve through
+        the task-level cache (evenly spaced here: tokenization is lazy, so
+        per-sample sizes are unknown at load time) so batch_by_size's
+        bucket partition — if a sizes-aware dataset engages it — uses the
+        same edge set the collater pads to."""
+        buckets = self.length_bucket_edges()
         return RightPadDataset(
             dataset,
             pad_idx=self.dictionary.pad(),
             pad_to_multiple=self.args.seq_pad_multiple,
+            pad_to_buckets=buckets,
         )
 
     def load_dataset(self, split, combine=False, **kwargs):

@@ -33,6 +33,7 @@ import contextlib
 import logging
 import os
 import sys
+import threading
 import time
 from argparse import Namespace
 from functools import partial
@@ -135,6 +136,27 @@ class Trainer(object):
         self._num_updates = 0
         self._loss_fn = task.loss_fn(model, loss)
         self._jit_cache: Dict[str, Any] = {}
+
+        # input-pipeline / compilation observability (data/prefetch.py):
+        # - _prep_counts / _hot_thread_preps instrument WHERE host-side
+        #   batch prep runs (the prefetch contract: none on the training
+        #   thread while consuming a prepared update);
+        # - _transfer_wall / _prefetch_wall feed the metrics stream;
+        # - _compiled_seen / _recompile_count watch the jit caches so a
+        #   recompile past --compile-warmup-updates WARNs loudly.
+        self._prep_counts: Dict[str, int] = {}
+        self._hot_thread_preps = 0
+        self._prepared_dispatch_thread: Optional[int] = None
+        self._wall_lock = threading.Lock()
+        self._transfer_wall = 0.0
+        self._prefetch_wall = 0.0
+        self._compiled_seen = 0
+        self._recompile_count = 0
+        # warmup is counted in updates run by THIS process: compiles are
+        # per-process, so a resumed run re-warms even though the global
+        # update counter is already past --compile-warmup-updates
+        self._updates_this_process = 0
+        self._active_prefetcher = None
 
         self._start_time = time.time()
         self._previous_training_time = 0
@@ -255,7 +277,8 @@ class Trainer(object):
         if self.use_ema:
             master = opt_state["master"] if opt_state["master"] is not None else params
             state["ema"] = init_ema(master)
-        self._state = jax.device_put(state, self._state_shardings(state))
+        # one-time TrainState placement at init — not hot-loop work
+        self._state = jax.device_put(state, self._state_shardings(state))  # lint: explicit-sync
         n_params = sum(
             int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
         )
@@ -733,10 +756,29 @@ class Trainer(object):
 
     @metrics.aggregate("train")
     def train_step(self, samples):
-        """One update from a list of micro-batches (GroupedIterator chunk)."""
-        # fault-injection hooks (no-ops unless --fault-inject armed a plan)
+        """One update from a list of micro-batches (GroupedIterator chunk),
+        or from a prefetched item (:mod:`unicore_tpu.data.prefetch`): a
+        :class:`PreparedUpdate` dispatches straight to the jitted step with
+        ZERO host-side batch prep on this thread; a :class:`RawUpdate`
+        reuses its already-agreed slot plan and runs the synchronous path."""
+        from unicore_tpu.data.prefetch import PreparedUpdate, RawUpdate
+
+        prepared = samples if isinstance(samples, PreparedUpdate) else None
+        plan = None  # (modes, sigs, stop_flags) agreed ahead of time
+        if isinstance(samples, (PreparedUpdate, RawUpdate)):
+            item = samples
+            plan = (item.modes, item.sigs, item.stop_flags)
+            samples = (
+                item.raw_samples if prepared is not None else item.samples
+            )
+
+        # fault-injection hooks (no-ops unless --fault-inject armed a plan;
+        # prefetch is disabled outright when it is — maybe_prefetch)
         chaos.maybe_raise(self.get_num_updates())
-        samples = chaos.maybe_perturb_geometry(self.get_num_updates(), samples)
+        if prepared is None:
+            samples = chaos.maybe_perturb_geometry(
+                self.get_num_updates(), samples
+            )
 
         if self._state is None:
             first_real = next((s for s in samples if s), None)
@@ -753,16 +795,42 @@ class Trainer(object):
         n = len(samples)
 
         with self._oom_guard(samples[0]):
-            if n == 1:
-                sample, weight = self._prepare_sample_or_dummy(samples[0])
+            if prepared is not None:
+                self._note_plan_consumed(plan[1], plan[0], plan[2])
+                self._prefetch_wall += prepared.prefetch_wall
+                # hot-thread prep guard: any _prepare_*/_plan_slots call on
+                # this thread before the dispatches finish is a prefetch
+                # contract violation (counted, asserted by the tests)
+                self._prepared_dispatch_thread = threading.get_ident()
+                try:
+                    new_state, self._macc = self._dispatch_prepared(
+                        state, prepared
+                    )
+                finally:
+                    self._prepared_dispatch_thread = None
+            elif n == 1:
+                mode = None
+                if plan is not None and plan[0] is not None:
+                    self._note_plan_consumed(plan[1], plan[0], plan[2])
+                    mode = plan[0][0]
+                sample, weight = self._prepare_sample_or_dummy(
+                    samples[0], mode=mode
+                )
                 new_state, self._macc = self._get_jit("train_step")(
                     state, sample, self._step_scalars(0, weight), self._macc
                 )
             else:
-                modes = (
-                    self._plan_slots(samples) if jax.process_count() > 1 else None
-                )
-                stacked = self._try_stack_microbatches(samples, modes)
+                if plan is not None and plan[0] is not None:
+                    modes, sigs, stop_flags = plan
+                    self._note_plan_consumed(sigs, modes, stop_flags)
+                elif jax.process_count() > 1:
+                    modes, sigs, stop_flags = self._plan_slots(samples)
+                    self._note_plan_consumed(sigs, modes, stop_flags)
+                else:
+                    modes = None
+                    sigs = plan[1] if plan is not None else None
+                stacked = self._try_stack_microbatches(samples, modes,
+                                                       sigs=sigs)
                 if stacked is not None:
                     # all micro-batches share shapes: ONE compiled program scans
                     # the whole accumulation (no per-micro-batch dispatch)
@@ -787,6 +855,10 @@ class Trainer(object):
         self._state = new_state
         self._cached_eval_params = None
         self.set_num_updates(self.get_num_updates() + 1)
+        # compile observability: count new jit-cache entries and WARN when
+        # one appears past --compile-warmup-updates (unstable geometry)
+        self._updates_this_process += 1
+        self._watch_recompiles()
         # cross-host fingerprint check every --consistency-check-interval
         # updates (multi-host only; raises ConsistencyError naming the
         # divergent rank + field).  note_step feeds the watchdog's report.
@@ -803,7 +875,9 @@ class Trainer(object):
             # rescale, so it is a genuine bad gradient even with scaling
             # on.  Without scaling, any non-finite gradient is genuine.
             key = "nan_grads" if self.use_loss_scale else "overflow"
-            seen = float(jax.device_get(self._macc[key]))
+            # opt-in --nan-rerun sync: the documented one-host-sync-per-step
+            # cost of reference-parity NaN localization
+            seen = float(jax.device_get(self._macc[key]))  # lint: explicit-sync
             if seen > self._nan_rerun_seen:
                 self._nan_rerun_seen = seen
                 detail = self._localize_nan(samples)
@@ -815,6 +889,167 @@ class Trainer(object):
 
         metrics.log_stop_time("train_wall")
         return True
+
+    def _dispatch_prepared(self, state, item):
+        """Dispatch one prefetched update: the arrays are already on device
+        in their final layout, so the only per-update work here is the
+        jitted call(s) themselves."""
+        if item.kind == "single":
+            return self._get_jit("train_step")(
+                state, item.data, self._step_scalars(0, item.weight),
+                self._macc,
+            )
+        if item.kind == "scan":
+            return self._get_jit("scan_step")(
+                state, item.data, self._step_scalars(0), self._macc
+            )
+        assert item.kind == "micro", item.kind
+        acc = None
+        micro = self._get_jit("micro_step")
+        for i, sample in enumerate(item.data):
+            acc = micro(
+                state["params"], state["loss_scale"], sample, acc,
+                self._step_scalars(i, item.weight),
+            )
+        return self._get_jit("apply_step")(
+            state, acc, self._step_scalars(0), self._macc
+        )
+
+    def prepare_prefetched(self, samples, modes, sigs):
+        """Producer-thread batch prep for the device prefetcher: narrow,
+        stack, and transfer one update's micro-batches.  Only called for
+        updates whose agreed plan is prefetchable (all 'shard' on
+        multi-host; all non-empty on single-host) — everything else takes
+        the RawUpdate fallback through the synchronous path.
+
+        Returns ``(kind, data, weight)`` for :meth:`_dispatch_prepared`.
+        Dummy-batch caching stays off here (``cache_dummy=False``): the
+        training thread caches it on the first (synchronous) update of the
+        epoch, so WHICH batch becomes the dummy is host-deterministic."""
+        if len(samples) == 1:
+            if modes is not None:
+                prepared = self._prepare_shard_global(samples[0])
+            else:
+                prepared = self._prepare_sample(samples[0])
+            return "single", prepared, 1.0
+        stacked = self._try_stack_microbatches(
+            samples, modes, sigs=sigs, cache_dummy=False
+        )
+        if stacked is not None:
+            return "scan", stacked, 1.0
+        slots = [
+            self._prepare_shard_global(s)
+            if modes is not None
+            else self._prepare_sample(s)
+            for s in samples
+        ]
+        return "micro", slots, 1.0
+
+    def maybe_prefetch(self, itr, epoch_itr=None, epoch=1):
+        """Wrap a grouped update iterator in the double-buffered device
+        prefetcher (``--prefetch-to-device``), or return it unchanged when
+        prefetch is off or a conservative-fallback condition applies:
+        ``--fault-inject`` (the chaos hooks must see raw host batches on
+        the training thread) and multi-host runs without a coordination-
+        service KV store (the off-thread slot plan needs the TCP side
+        channel to stay out of device-collective program order)."""
+        from unicore_tpu.data import prefetch as prefetch_mod
+
+        if not getattr(self.args, "prefetch_to_device", False):
+            return itr
+        if getattr(self.args, "fault_inject", None):
+            logger.warning(
+                "--prefetch-to-device disabled for this run: --fault-inject "
+                "perturbations apply to raw host batches on the training "
+                "thread (conservative fallback)"
+            )
+            return itr
+        if jax.process_count() > 1 and prefetch_mod.kv_client() is None:
+            logger.warning(
+                "--prefetch-to-device disabled: no distributed coordination "
+                "client for the off-thread slot-plan exchange (was "
+                "jax.distributed.initialize called?)"
+            )
+            return itr
+        pf = prefetch_mod.DevicePrefetcher(
+            self, itr, epoch=epoch,
+            # NOT --data-buffer-size: that flag's default (10) is tuned for
+            # the host-side loader, and 10 device-resident prepared updates
+            # is an HBM liability, not a latency win
+            depth=max(1, getattr(self.args, "prefetch_depth", 2) or 2),
+            plan_timeout=getattr(self.args, "collective_timeout", 0) or 600.0,
+        )
+        if epoch_itr is not None:
+            pf.attach_epoch_itr(epoch_itr)
+        self._active_prefetcher = pf
+        pf.start()
+        return pf
+
+    def finish_prefetch(self, itr):
+        """Tear down a prefetcher returned by :meth:`maybe_prefetch`
+        (no-op for a plain iterator)."""
+        from unicore_tpu.data.prefetch import DevicePrefetcher
+
+        if isinstance(itr, DevicePrefetcher):
+            itr.close()
+        if self._active_prefetcher is itr:
+            self._active_prefetcher = None
+
+    #: jit-cache entries that make up the TRAIN step (valid_step compiles
+    #: are expected at each new validation geometry and don't gate the
+    #: one-program-per-update promise)
+    _TRAIN_PROGRAM_KEYS = ("train_step", "scan_step", "micro_step",
+                           "apply_step")
+
+    def _count_compiled_programs(self) -> int:
+        """Total compiled-executable count across the train-step jit
+        caches — the denominator of the one-XLA-program-per-update
+        promise."""
+        total = 0
+        for key in self._TRAIN_PROGRAM_KEYS:
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                continue
+            try:
+                total += int(fn._cache_size())
+            except Exception:
+                # private jit API: a jax upgrade renaming it would silently
+                # zero the recompiles gauge AND mute the after-warmup
+                # warning — say so once instead
+                if not getattr(self, "_cache_size_probe_warned", False):
+                    self._cache_size_probe_warned = True
+                    logger.warning(
+                        "jit _cache_size() probe failed (jax version "
+                        "change?): the 'recompiles' metric and the "
+                        "recompile-after-warmup warning are disabled"
+                    )
+        return total
+
+    def _watch_recompiles(self):
+        """Track compile events into the ``recompiles`` metric and WARN
+        when one fires past ``--compile-warmup-updates`` — by then every
+        batch geometry should have been seen (use --length-bucket to bound
+        the geometry set if this keeps firing)."""
+        n = self._count_compiled_programs()
+        if n <= self._compiled_seen:
+            return
+        grew = n - self._compiled_seen
+        first = self._compiled_seen == 0
+        self._compiled_seen = n
+        self._recompile_count += grew
+        warmup = int(getattr(self.args, "compile_warmup_updates", 0) or 0)
+        step = self.get_num_updates()
+        # warmup is process-relative: a resumed run re-compiles its working
+        # set even though the global update counter is long past warmup
+        if not first and warmup > 0 and self._updates_this_process > warmup:
+            logger.warning(
+                f"recompile after warmup: {grew} new train-step program(s) "
+                f"compiled at update {step} (--compile-warmup-updates="
+                f"{warmup}, {n} programs total).  A new batch geometry "
+                "reached the device — bound the shape set with "
+                "--length-bucket / --required-batch-size-multiple, or raise "
+                "the warmup if this geometry is expected (epoch tail)."
+            )
 
     def _localize_nan(self, samples):
         """Eager re-run of the offending batch: forward with captured
@@ -920,6 +1155,24 @@ class Trainer(object):
         if self.use_loss_scale and loss_scale_sum is not None:
             metrics.log_scalar(
                 "loss_scale", loss_scale_sum / n, n, priority=700, round=4
+            )
+        # input-pipeline + compile observability (docs/performance.md):
+        # cumulative compiled-program count across the step caches, and the
+        # interval's producer prep / host->device transfer wall seconds
+        metrics.log_scalar(
+            "recompiles", float(self._recompile_count), weight=0,
+            priority=1600, round=0,
+        )
+        with self._wall_lock:
+            transfer_wall, self._transfer_wall = self._transfer_wall, 0.0
+        prefetch_wall, self._prefetch_wall = self._prefetch_wall, 0.0
+        metrics.log_scalar(
+            "transfer_wall", transfer_wall, weight=0, priority=1610, round=3
+        )
+        if getattr(self.args, "prefetch_to_device", False):
+            metrics.log_scalar(
+                "prefetch_wall", prefetch_wall, weight=0, priority=1620,
+                round=3,
             )
         # device free-HBM health scalar (reference trainer.py:1086-1124
         # logs gb_free); one host query per flush interval
@@ -1050,30 +1303,32 @@ class Trainer(object):
         guard fingerprints the exact same geometry the slot plan uses.)"""
         return guard.batch_signature(sample)
 
-    def _plan_slots(self, samples):
+    def _plan_slots(self, samples, sigs=None):
         """Multi-host only: agree, across hosts, how each micro-slot's batch
         will be laid out.  ONE tiny pickled all-gather per update (the
         reference pays a pickled all_gather_list per update for logging
-        outputs anyway, trainer.py:967-1049).  Modes:
+        outputs anyway, trainer.py:967-1049).  Mode semantics live in
+        :func:`unicore_tpu.data.prefetch.plan_slot_modes`, shared with the
+        prefetcher's off-thread KV exchange so both paths decide layouts
+        identically.
 
-        - ``shard``:  every host holds a same-shaped batch whose rows divide
-          its local data-shard count — each host contributes exactly its rows
-          to ONE global P('data') array
-          (``jax.make_array_from_process_local_data``);
-        - ``gather``: shapes diverge / some hosts empty / rows not divisible
-          (epoch tails) — hosts exchange the actual rows and every host
-          materializes the SAME concatenated batch, legitimately replicated;
-        - ``dummy``:  no host has data (GroupedIterator padding) — weight-0
-          step on the cached, globally-consistent dummy batch.
+        Returns ``(modes, sigs, stop_flags)``.  Guard bookkeeping (batch
+        sigs, plan hash, the piggybacked graceful-stop flags) is NOT done
+        here — the caller notes it at consumption time via
+        :meth:`_note_plan_consumed`, so a plan computed ahead of time by
+        the prefetcher feeds the fingerprint/stop machinery in exact
+        update order.
 
         Host-divergent data must NEVER ship under a replicated or global-mesh
         sharding from plain device_put: JAX treats the input as the global
         array value, silently dropping rows (sharded) or desyncing params
         (replicated)."""
+        from unicore_tpu.data.prefetch import plan_slot_modes
         from unicore_tpu.parallel import DATA_AXIS
 
-        sigs = [self._local_sig(s) for s in samples]
-        self.guard.note_batch_sigs(sigs)
+        self._count_prep("plan_slots")
+        if sigs is None:
+            sigs = [self._local_sig(s) for s in samples]
         # fixed max_size keeps this ONE collective round (auto-sizing would
         # add a length-gather round on the hot path); signatures are tiny.
         # The graceful-stop flag rides along so the CLI's stop decision is
@@ -1082,41 +1337,59 @@ class Trainer(object):
             (sigs, guard.stop_requested()), max_size=1 << 16
         )
         all_sigs = [row[0] for row in gathered]
-        guard.note_gathered_stop_flags(row[1] for row in gathered)
-        nproc = jax.process_count()
-        data_size = self.mesh.shape[DATA_AXIS]
-        local_shards = data_size // nproc if data_size % nproc == 0 else 0
-        modes = []
-        for i in range(len(samples)):
-            slot = [host_sigs[i] for host_sigs in all_sigs]
-            if all(s is None for s in slot):
-                modes.append("dummy")
-            elif (
-                local_shards > 0
-                and all(s == slot[0] for s in slot)
-                and slot[0] not in (None, "unshardable")
-                and all(
-                    shape[0] % local_shards == 0 for shape, _ in slot[0][1]
-                )
-            ):
-                modes.append("shard")
-            else:
-                modes.append("gather")
-        self.guard.note_plan(modes)
-        return modes
+        stop_flags = [row[1] for row in gathered]
+        modes = plan_slot_modes(
+            all_sigs, self.mesh.shape[DATA_AXIS], jax.process_count()
+        )
+        return modes, sigs, stop_flags
+
+    def _note_plan_consumed(self, sigs, modes, stop_flags):
+        """Record a slot plan into the consistency guard at CONSUMPTION
+        time.  Both the synchronous path and the prefetcher route through
+        here, so the fingerprint's batch-sig/plan fields and the agreed
+        stop decision advance in update order on every host regardless of
+        how far ahead the producer thread has planned."""
+        self.guard.note_batch_sigs(sigs)
+        if modes is not None:
+            self.guard.note_plan(modes)
+        if stop_flags is not None:
+            guard.note_gathered_stop_flags(stop_flags)
+
+    def _count_prep(self, what):
+        """Host-side batch-prep instrumentation: counts per prep function,
+        plus a dedicated counter for the prefetch contract violation —
+        prep running on the training thread while it consumes a prepared
+        update (tests/test_prefetch.py asserts this stays zero)."""
+        with self._wall_lock:  # producer + training thread both count
+            self._prep_counts[what] = self._prep_counts.get(what, 0) + 1
+            if self._prepared_dispatch_thread == threading.get_ident():
+                self._hot_thread_preps += 1
+
+    @contextlib.contextmanager
+    def _transfer_timer(self):
+        """Accumulate host->device transfer time into the ``transfer_wall``
+        metric (producer thread and training thread both report here)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._wall_lock:
+                self._transfer_wall += time.perf_counter() - t0
 
     def _prepare_shard_global(self, sample):
         """Each host contributes its local rows to one global batch laid out
         P('data') over the mesh (the multi-host analogue of the reference's
         per-rank iterator shards feeding per-rank DDP replicas)."""
+        self._count_prep("prepare_shard_global")
         sample = utils.apply_to_sample(
             lambda x: _narrow_dtype(np.ascontiguousarray(x)), sample
         )
         sharding = self._batch_sharding
-        return utils.apply_to_sample(
-            lambda x: jax.make_array_from_process_local_data(sharding, x),
-            sample,
-        )
+        with self._transfer_timer():
+            return utils.apply_to_sample(
+                lambda x: jax.make_array_from_process_local_data(sharding, x),
+                sample,
+            )
 
     def _prepare_gather_global(self, sample):
         """Epoch-tail path: exchange rows so every host holds the SAME
@@ -1124,6 +1397,7 @@ class Trainer(object):
         replication is within the SPMD model; one odd-shaped step per epoch
         costs a cached recompile but stays numerically exact).  Returns None
         when every host was empty."""
+        self._count_prep("prepare_gather_global")
         local = (
             None
             if self._is_empty(sample)
@@ -1145,11 +1419,13 @@ class Trainer(object):
                 return np.concatenate([np.asarray(x) for x in xs], axis=0)
 
             cat = jax.tree_util.tree_map(_cat, *parts)
-        return utils.move_to_device(cat, self._replicated)
+        with self._transfer_timer():
+            return utils.move_to_device(cat, self._replicated)
 
     def _prepare_sample(self, sample, init=False):
         if init:
             return utils.apply_to_sample(np.asarray, sample)
+        self._count_prep("prepare_sample")
         # single-host path: tail batches whose row count doesn't divide the
         # data axis can't be laid out P('data'); replicate those (exact, one
         # cached recompile per odd shape)
@@ -1163,29 +1439,40 @@ class Trainer(object):
         divisible = all(leaf.shape[0] % data_size == 0 for leaf in leaves)
         sharding = self._batch_sharding if divisible else self._replicated
         sample = utils.apply_to_sample(_narrow_dtype, sample)
-        return utils.move_to_device(sample, sharding)
+        with self._transfer_timer():
+            return utils.move_to_device(sample, sharding)
 
-    def _try_stack_microbatches(self, samples, modes=None):
+    def _try_stack_microbatches(self, samples, modes=None, sigs=None,
+                                cache_dummy=True):
         """Stack same-shaped micro-batches on a leading axis for the fused
         scan path (device layout: micro axis replicated, batch dim sharded
         over 'data'); returns None when shapes differ or any slot is a
         dummy.  Multi-host: usable when the agreed plan says every slot is
         'shard' and this host's slots are same-shaped — then every other
         host's are too (per-slot cross-host equality from the plan), and each
-        host contributes its rows of the stacked global array."""
+        host contributes its rows of the stacked global array.
+
+        ``sigs`` are the slot signatures the planner already computed —
+        threaded through so they are derived exactly once per update.
+        ``cache_dummy=False`` is the prefetcher's producer thread: only the
+        training thread may cache the dummy batch (first update of each
+        epoch), keeping WHICH batch becomes the dummy host-deterministic."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from unicore_tpu.parallel import DATA_AXIS
 
+        self._count_prep("stack_microbatches")
         multihost = jax.process_count() > 1
         if multihost and (modes is None or any(m != "shard" for m in modes)):
             return None
         if any(self._is_empty(s) for s in samples):
             return None
-        sig0 = self._local_sig(samples[0])
+        if sigs is None:
+            sigs = [self._local_sig(s) for s in samples]
+        sig0 = sigs[0]
         if sig0 in (None, "unshardable"):
             return None
-        if any(self._local_sig(s) != sig0 for s in samples[1:]):
+        if any(s != sig0 for s in sigs[1:]):
             return None
         host = [utils.apply_to_sample(_narrow_dtype, s) for s in samples]
         stacked = jax.tree_util.tree_map(
@@ -1195,11 +1482,12 @@ class Trainer(object):
         data_size = self.mesh.shape[DATA_AXIS]
         spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
         if multihost:
-            out = utils.apply_to_sample(
-                lambda x: jax.make_array_from_process_local_data(spec, x),
-                stacked,
-            )
-            if self._dummy_batch is None:
+            with self._transfer_timer():
+                out = utils.apply_to_sample(
+                    lambda x: jax.make_array_from_process_local_data(spec, x),
+                    stacked,
+                )
+            if cache_dummy and self._dummy_batch is None:
                 # slice one micro-slot off the global array: identical on all
                 # hosts by construction (a host-local prepare would not be)
                 self._dummy_batch = jax.tree_util.tree_map(
@@ -1211,9 +1499,10 @@ class Trainer(object):
             for leaf in jax.tree_util.tree_leaves(stacked)
         )
         sharding = spec if divisible else self._replicated
-        if self._dummy_batch is None:
+        if cache_dummy and self._dummy_batch is None:
             self._dummy_batch = self._prepare_sample(samples[0])
-        return utils.move_to_device(stacked, sharding)
+        with self._transfer_timer():
+            return utils.move_to_device(stacked, sharding)
 
     def _prepare_sample_or_dummy(self, sample, mode=None):
         """Empty shard-tail batches become weight-0 dummy steps so all hosts
@@ -1223,7 +1512,9 @@ class Trainer(object):
         host ever feeds a divergent value into a replicated jit input."""
         if jax.process_count() > 1:
             if mode is None:
-                mode = self._plan_slots([sample])[0]
+                modes, sigs, stop_flags = self._plan_slots([sample])
+                self._note_plan_consumed(sigs, modes, stop_flags)
+                mode = modes[0]
             if mode == "dummy":
                 assert self._dummy_batch is not None, "no dummy batch cached yet"
                 return self._dummy_batch, 0.0

@@ -230,6 +230,14 @@ def main(args) -> None:
     np.random.seed(args.seed)
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
+    if getattr(args, "jax_compilation_cache_dir", None):
+        # persistent XLA compile cache: restarts and repeated runs of the
+        # same config reload their train-step programs instead of
+        # recompiling (docs/performance.md)
+        jax.config.update(
+            "jax_compilation_cache_dir", args.jax_compilation_cache_dir
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     if distributed_utils.is_master(args):
         for d in (args.save_dir, args.tmp_save_dir):
@@ -327,6 +335,12 @@ def train_epoch(args, session, epoch_itr):
         uf_schedule = args.update_freq
         update_freq = uf_schedule[min(epoch, len(uf_schedule)) - 1]
         itr = iterators.GroupedIterator(itr, update_freq)
+        # --prefetch-to-device: a producer thread plans/stacks/transfers
+        # update N+1 while update N computes; items arrive as
+        # PreparedUpdate/RawUpdate and train_step dispatches accordingly.
+        # The prefetcher also overrides epoch_itr's position bookkeeping so
+        # mid-epoch checkpoints record the CONSUMED position.
+        itr = trainer.maybe_prefetch(itr, epoch_itr=epoch_itr, epoch=epoch)
 
         progress = _make_progress(
             args, itr, epoch,
@@ -342,37 +356,43 @@ def train_epoch(args, session, epoch_itr):
         valid_losses, stop = [None], False
         num_updates = trainer.get_num_updates()
 
-        for grouped_samples in progress:
-            with metrics.aggregate("train_inner"):
-                step_ok = trainer.train_step(grouped_samples) is not None
-                # training-health sentinel tick (no-op unless
-                # --sentinel-interval > 0): observe this update's metrics,
-                # rewind + fast-forward `itr` on a confirmed anomaly, and
-                # capture rewind snapshots on the --snapshot-interval
-                # cadence.  Before flush_metrics so the device-side sums
-                # still include this update.
-                trainer.health_check(epoch_itr, itr)
-                num_updates = trainer.get_num_updates()
-                at_log_point = num_updates % args.log_interval == 0
-                if at_log_point:
-                    # one device fetch per interval, inside the train_inner
-                    # scope so the sums land in this aggregator
-                    trainer.flush_metrics()
+        try:
+            for grouped_samples in progress:
+                with metrics.aggregate("train_inner"):
+                    step_ok = trainer.train_step(grouped_samples) is not None
+                    # training-health sentinel tick (no-op unless
+                    # --sentinel-interval > 0): observe this update's metrics,
+                    # rewind + fast-forward `itr` on a confirmed anomaly, and
+                    # capture rewind snapshots on the --snapshot-interval
+                    # cadence.  Before flush_metrics so the device-side sums
+                    # still include this update.
+                    trainer.health_check(epoch_itr, itr)
+                    num_updates = trainer.get_num_updates()
+                    at_log_point = num_updates % args.log_interval == 0
+                    if at_log_point:
+                        # one device fetch per interval, inside the
+                        # train_inner scope so the sums land in this
+                        # aggregator
+                        trainer.flush_metrics()
 
-            if step_ok and at_log_point:
-                progress.log(
-                    _with_wall(metrics.get_smoothed_values("train_inner")),
-                    tag="train_inner", step=num_updates,
+                if step_ok and at_log_point:
+                    progress.log(
+                        _with_wall(metrics.get_smoothed_values("train_inner")),
+                        tag="train_inner", step=num_updates,
+                    )
+                    # interval stats restart here; the epoch aggregate above
+                    # keeps accumulating independently
+                    metrics.reset_meters("train_inner")
+
+                valid_losses, stop = session.checkpoint_and_validate(
+                    epoch_itr, end_of_epoch=not itr.has_next()
                 )
-                # interval stats restart here; the epoch aggregate above
-                # keeps accumulating independently
-                metrics.reset_meters("train_inner")
-
-            valid_losses, stop = session.checkpoint_and_validate(
-                epoch_itr, end_of_epoch=not itr.has_next()
-            )
-            if stop:
-                break
+                if stop:
+                    break
+        finally:
+            # stop the prefetch producer (no-op for a plain iterator);
+            # checkpoints taken above already recorded the consumed position
+            trainer.finish_prefetch(itr)
 
     logger.info(f"end of epoch {epoch} (average epoch stats below)")
     trainer.flush_metrics()
