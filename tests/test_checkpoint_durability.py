@@ -1,0 +1,880 @@
+"""Durable checkpoints (ISSUE 5): format v2 integrity manifests, fsync'd
+atomic publishes, ENOSPC preflight + save-failure escalation,
+deadline-bounded emergency saves, and the storage chaos kinds
+(bit-flip-checkpoint / disk-full / slow-disk) that prove each path
+end-to-end — a flipped payload byte must be rejected at load BEFORE any
+state is applied, and a multi-host run must agree on the fallback."""
+
+import os
+import pickle
+import subprocess
+import sys
+import time
+from argparse import Namespace
+
+import numpy as np
+import pytest
+
+from unicore_tpu import checkpoint_utils
+from unicore_tpu.checkpoint import durable, format as ckpt_format
+from unicore_tpu.distributed import chaos, guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    yield
+    chaos.reset()
+    guard.reset()
+    durable.reset()
+    checkpoint_utils.set_best_score(None)
+
+
+# ---------------------------------------------------------------------------
+# format v2: manifest round-trip, header provenance, v1 compat
+# ---------------------------------------------------------------------------
+
+
+def test_v2_roundtrip_header_and_sniff(tmp_path):
+    obj = {"model": {"w": np.arange(512, dtype=np.float32)},
+           "extra_state": {"epoch": 3}}
+    path = str(tmp_path / "ckpt.pt")
+    meta = {"step": 40, "config_digest": "cafe1234cafe1234",
+            "suffix": "", "process_count": 1, "mesh": {"data": 8}}
+    assert checkpoint_utils.persistent_save(obj, path, meta=meta) is True
+
+    assert checkpoint_utils.detect_checkpoint_format(path) == "v2"
+    header = ckpt_format.read_header(path)
+    assert header["version"] == 2
+    assert header["step"] == 40
+    assert header["config_digest"] == "cafe1234cafe1234"
+    assert header["mesh"] == {"data": 8}
+
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)
+    np.testing.assert_array_equal(loaded["model"]["w"], obj["model"]["w"])
+    assert loaded["extra_state"]["epoch"] == 3
+
+
+def test_v1_pre_manifest_checkpoints_still_load(tmp_path):
+    """Acceptance: v1 (bare-pickle, pre-manifest) checkpoints load
+    transparently — both ones written by old code and ones written via
+    --checkpoint-write-version 1."""
+    obj = {"model": {"w": np.ones((4,), np.float32)}, "extra_state": {"e": 1}}
+    old = str(tmp_path / "old.pt")
+    with open(old, "wb") as f:  # a file written by pre-manifest code
+        pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+    assert checkpoint_utils.detect_checkpoint_format(old) == "pickle"
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(old)
+    np.testing.assert_array_equal(loaded["model"]["w"], obj["model"]["w"])
+
+    durable.configure(Namespace(checkpoint_write_version=1))
+    new = str(tmp_path / "new.pt")
+    checkpoint_utils.persistent_save(obj, new)
+    assert checkpoint_utils.detect_checkpoint_format(new) == "pickle"
+    assert checkpoint_utils.load_checkpoint_to_cpu(new)["extra_state"]["e"] == 1
+
+
+def _flip_payload_byte(path, offset=None):
+    lo, hi = ckpt_format.payload_bounds(path) or (
+        os.path.getsize(path) // 4, os.path.getsize(path)
+    )
+    off = offset if offset is not None else (lo + hi) // 2
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x01]))
+
+
+def test_single_flipped_byte_rejected_before_unpickle(tmp_path, monkeypatch):
+    """Acceptance: ONE flipped payload byte raises CorruptCheckpointError
+    at load, BEFORE the payload is unpickled (no state is ever applied)."""
+    obj = {"model": {"w": np.arange(4096, dtype=np.float32)}}
+    path = str(tmp_path / "ckpt.pt")
+    checkpoint_utils.persistent_save(obj, path)
+    _flip_payload_byte(path)
+
+    unpickled = []
+    real_load = pickle.load
+    monkeypatch.setattr(
+        ckpt_format.pickle, "load",
+        lambda f, **kw: (unpickled.append(1), real_load(f, **kw))[1],
+    )
+    with pytest.raises(
+        checkpoint_utils.CorruptCheckpointError, match="integrity manifest"
+    ):
+        checkpoint_utils.load_checkpoint_to_cpu(path)
+    assert unpickled == []  # verification refused BEFORE any unpickling
+
+
+def test_v1_cannot_catch_the_same_flip(tmp_path):
+    """The motivating hole: the identical single-byte flip in a v1 pickle
+    unpickles CLEANLY into silently wrong weights — exactly what the v2
+    manifest exists to catch."""
+    durable.configure(Namespace(checkpoint_write_version=1))
+    obj = {"model": {"w": np.arange(4096, dtype=np.float32)}}
+    path = str(tmp_path / "ckpt.pt")
+    checkpoint_utils.persistent_save(obj, path)
+    _flip_payload_byte(path, offset=os.path.getsize(path) // 2)
+
+    loaded = checkpoint_utils.load_checkpoint_to_cpu(path)  # no error!
+    assert not np.array_equal(loaded["model"]["w"], obj["model"]["w"])
+
+
+def test_multi_chunk_manifest_names_the_damaged_chunk(tmp_path):
+    obj = {"model": {"w": np.zeros(8192, dtype=np.float64)}}  # 64 KiB
+    path = str(tmp_path / "ckpt.pt")
+    ckpt_format.write(obj, path, chunk_size=4096)
+    lo, hi = ckpt_format.payload_bounds(path)
+    n_chunks = (hi - lo + 4095) // 4096
+    assert n_chunks >= 16
+    _flip_payload_byte(path, offset=lo + 3 * 4096 + 7)  # inside chunk 4
+    with pytest.raises(
+        checkpoint_utils.CorruptCheckpointError,
+        match=rf"chunk 4/{n_chunks}",
+    ):
+        ckpt_format.verify(path)
+
+
+def test_torn_tail_diagnosed_structurally(tmp_path):
+    obj = {"model": {"w": np.arange(1024, dtype=np.float32)}}
+    path = str(tmp_path / "ckpt.pt")
+    checkpoint_utils.persistent_save(obj, path)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(
+        checkpoint_utils.CorruptCheckpointError, match="torn"
+    ):
+        checkpoint_utils.load_checkpoint_to_cpu(path)
+
+
+def test_bitflip_flows_into_resume_fallback(tmp_path, caplog):
+    """Verified-load corruption enters the SAME fallback ladder the
+    truncate-checkpoint chaos kind proved: resume falls back to the
+    next-newest retained checkpoint."""
+
+    class StubTrainer:
+        checkpoint_suffix = ""
+        loaded_path = None
+
+        def load_checkpoint(self, path, *a, **k):
+            if not os.path.exists(path):
+                return None
+            state = checkpoint_utils.load_checkpoint_to_cpu(path)
+            self.loaded_path = path
+            return state.get("extra_state")
+
+    def write(name, epoch):
+        checkpoint_utils.persistent_save(
+            {"model": {"w": np.full((64,), float(epoch), np.float32)},
+             "extra_state": {"epoch": epoch}},
+            str(tmp_path / name),
+        )
+        time.sleep(0.02)
+
+    write("checkpoint_1_100.pt", 1)
+    write("checkpoint_1_200.pt", 2)
+    write("checkpoint_last.pt", 3)
+    _flip_payload_byte(str(tmp_path / "checkpoint_last.pt"))
+
+    args = Namespace(
+        save_dir=str(tmp_path), restore_file="checkpoint_last.pt",
+        finetune_from_model=None, optimizer_overrides="{}",
+        reset_optimizer=False, reset_lr_scheduler=False,
+        reset_meters=False, reset_dataloader=False,
+    )
+    trainer = StubTrainer()
+    with caplog.at_level("WARNING"):
+        extra = checkpoint_utils.load_checkpoint(args, trainer)
+    assert trainer.loaded_path == str(tmp_path / "checkpoint_1_200.pt")
+    assert extra["epoch"] == 2
+    warned = "\n".join(r.message for r in caplog.records)
+    assert "CHECKPOINT CORRUPT" in warned
+    assert "integrity manifest" in warned
+
+
+# ---------------------------------------------------------------------------
+# durable write path: publish crash window, fsync, preflight, escalation
+# ---------------------------------------------------------------------------
+
+
+def test_publish_one_crash_mid_copy_never_tears_final_name(
+    tmp_path, monkeypatch
+):
+    """Regression for the torn-checkpoint_best bug: a crash mid-copy must
+    leave the PREVIOUS good file under the final name untouched."""
+    src = tmp_path / "staged.pt"
+    dst = tmp_path / "checkpoint_best.pt"
+    src.write_bytes(b"N" * 4096)
+    dst.write_bytes(b"OLD-GOOD" * 512)
+    before = dst.read_bytes()
+
+    import shutil as _shutil
+
+    def torn_copy(s, d, **kw):
+        with open(d, "wb") as f:
+            f.write(b"N" * 17)  # half-written...
+        raise OSError("preempted mid-copy")
+
+    monkeypatch.setattr(_shutil, "copyfile", torn_copy)
+    with pytest.raises(OSError):
+        checkpoint_utils._publish_one(str(src), str(dst))
+    assert dst.read_bytes() == before  # final name untouched
+
+    monkeypatch.undo()
+    checkpoint_utils._publish_one(str(src), str(dst))
+    assert dst.read_bytes() == b"N" * 4096
+    assert not os.path.exists(str(dst) + ".tmp")
+
+
+def test_persistent_save_fsyncs_file_and_parent_dir(tmp_path, monkeypatch):
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (synced.append(fd), real_fsync(fd))[1])
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.ones(8)}}, str(tmp_path / "ckpt.pt")
+    )
+    # at least the staged file and the parent directory
+    assert len(synced) >= 2
+
+
+def test_enospc_preflight_refuses_to_start(tmp_path, monkeypatch, caplog):
+    import collections
+
+    usage = collections.namedtuple("usage", "total used free")
+    monkeypatch.setattr(
+        durable.shutil, "disk_usage", lambda d: usage(100, 90, 10)
+    )
+    path = str(tmp_path / "ckpt.pt")
+    with caplog.at_level("ERROR"):
+        ok = checkpoint_utils.persistent_save(
+            {"model": {"w": np.zeros(1 << 16, np.float32)}}, path
+        )
+    assert ok is False
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")  # never started the write
+    assert any("ENOSPC preflight" in r.message for r in caplog.records)
+    assert durable.tracker().token() == (1, 1)
+
+
+def test_disk_full_chaos_escalates_per_policy(tmp_path, monkeypatch, caplog):
+    """disk-full chaos → ENOSPC out of the write attempt: no pointless
+    retries (a full disk does not blip clear), warn logs + returns False,
+    abort raises CheckpointWriteError."""
+    chaos.configure(Namespace(fault_inject="disk-full@0"))
+    chaos.note_step(1)
+    sleeps = []
+    monkeypatch.setattr(checkpoint_utils.time, "sleep", sleeps.append)
+
+    path = str(tmp_path / "ckpt.pt")
+    with caplog.at_level("ERROR"):
+        ok = checkpoint_utils.persistent_save({"x": 1}, path)
+    assert ok is False and sleeps == []  # terminal on attempt 1
+    assert any("CHECKPOINT SAVE FAILED" in r.message for r in caplog.records)
+
+    durable.configure(Namespace(on_save_failure="abort"))
+    with pytest.raises(durable.CheckpointWriteError, match="abort"):
+        checkpoint_utils.persistent_save({"x": 1}, path)
+    assert durable.tracker().token() == (2, 2)  # consecutive, total
+
+
+def test_read_back_verification_catches_lying_storage(tmp_path, monkeypatch):
+    """--verify-checkpoint-writes: storage that ACKs bytes it corrupted is
+    caught ON THE STAGED FILE, before the rename — the previous good
+    checkpoint under the final name is never clobbered by a rotten write,
+    and exhausted retries escalate terminally instead of trusting it."""
+    durable.configure(
+        Namespace(verify_checkpoint_writes=True, on_save_failure="abort")
+    )
+    path = str(tmp_path / "checkpoint_last.pt")
+    checkpoint_utils.persistent_save({"model": {"w": np.zeros(4)}}, path)
+    good = open(path, "rb").read()
+
+    real_write = ckpt_format.write
+    writes = []
+
+    def rotten_write(obj, scratch, **kw):
+        real_write(obj, scratch, **kw)
+        writes.append(scratch)
+        _flip_payload_byte(scratch)
+
+    monkeypatch.setattr(ckpt_format, "write", rotten_write)
+    monkeypatch.setattr(checkpoint_utils.time, "sleep", lambda s: None)
+    with pytest.raises(durable.CheckpointWriteError):
+        checkpoint_utils.persistent_save(
+            {"model": {"w": np.arange(2048, dtype=np.float32)}}, path
+        )
+    assert len(writes) == 3  # every attempt was verified and rejected
+    assert open(path, "rb").read() == good  # good file never clobbered
+
+
+def test_save_health_rides_fingerprint_but_is_not_compared():
+    durable.tracker().note_failure("x.pt", RuntimeError("boom"))
+
+    class Stub:
+        def get_num_updates(self):
+            return 7
+
+        def get_lr(self):
+            return 1e-3
+
+        def current_loss_scale(self):
+            return 1.0
+
+    g = guard.ConsistencyGuard(Namespace(consistency_check_interval=1, seed=1))
+    fp = g.fingerprint(Stub())
+    assert fp["save_health"] == (1, 1)
+
+    # only the WRITER rank accrues failures — differing save_health must
+    # NOT trip the cross-host comparison
+    tag = "unicore-tpu-consistency-v1"
+    base = {"config": "c", "seed": 1, "step": 7, "lr": 1e-3,
+            "loss_scale": 1.0, "batch_sig": None, "dummy_plan": None,
+            "sentinel": None}
+    rows = [
+        (tag, {**base, "save_health": (3, 9)}),
+        (tag, {**base, "save_health": None}),
+    ]
+    assert guard.diagnose_fingerprints(rows) is None
+
+
+def test_async_publish_failure_escalates_at_next_save(tmp_path):
+    """ckp_copy_fun runs on the async pool and must never raise; with
+    --on-save-failure abort its parked failure surfaces at the NEXT
+    save_checkpoint on the training thread."""
+    durable.configure(Namespace(on_save_failure="abort"))
+    durable.tracker().note_failure(
+        "checkpoint_best.pt", OSError("EIO"), from_async=True
+    )
+
+    class Stub:
+        data_parallel_rank = 0
+
+    args = Namespace(save_dir=str(tmp_path / "s"),
+                     tmp_save_dir=str(tmp_path / "t"), no_save=True)
+    with pytest.raises(durable.CheckpointWriteError, match="async"):
+        checkpoint_utils.save_checkpoint(args, Stub(), None, None, None)
+
+
+def test_failed_staged_write_skips_publish_and_success_log(tmp_path, caplog):
+    """A terminal staged-write failure under --on-save-failure warn must
+    not publish (the staged file is gone — or worse, stale) nor log a
+    'Saved checkpoint' success line."""
+
+    class FailingTrainer:
+        checkpoint_suffix = ""
+        data_parallel_rank = 0
+        should_save_checkpoint_on_current_rank = True
+
+        def get_num_updates(self):
+            return 4
+
+        def save_checkpoint(self, filename, extra_state):
+            return False  # persistent_save failed terminally (warn policy)
+
+    class Itr:
+        epoch = 1
+
+        def state_dict(self):
+            return {"epoch": 1}
+
+        def end_of_epoch(self):
+            return False
+
+    args = Namespace(
+        save_dir=str(tmp_path / "save"), tmp_save_dir=str(tmp_path / "tmp"),
+        no_save=False, no_epoch_checkpoints=True, save_interval=1,
+        save_interval_updates=4, keep_best_checkpoints=-1,
+        best_checkpoint_metric="loss", maximize_best_checkpoint_metric=False,
+        no_last_checkpoints=False, checkpoint_format="pickle",
+    )
+    with caplog.at_level("INFO"):
+        checkpoint_utils.save_checkpoint(args, FailingTrainer(), Itr(),
+                                         None, None)
+    assert os.listdir(args.save_dir) == []  # nothing published
+    logged = "\n".join(r.message for r in caplog.records)
+    assert "skipping checkpoint publish" in logged
+    assert "Saved checkpoint" not in logged
+
+
+# ---------------------------------------------------------------------------
+# retention: sign-safe + collision-safe best stamps
+# ---------------------------------------------------------------------------
+
+
+class _RetainArgs:
+    tmp_save_dir = None
+    save_dir = None
+    keep_interval_updates = -1
+    keep_last_epochs = -1
+    keep_best_checkpoints = 2
+    best_checkpoint_metric = "loss"
+    maximize_best_checkpoint_metric = False
+
+
+def test_negative_best_scores_are_pruned(tmp_path):
+    """checkpoint.best_loss_-1.23... stamps used to defeat the (\\d...)
+    retention regex and accumulate forever; the sign-safe pair prunes
+    them, keeping the BEST (lowest, most negative) scores."""
+    args = _RetainArgs()
+    args.save_dir = args.tmp_save_dir = str(tmp_path)
+    for name in (
+        "checkpoint.best_loss_-1.20_20.pt",   # best
+        "checkpoint.best_loss_-0.50_10.pt",   # 2nd best
+        "checkpoint.best_loss_0.30_30.pt",    # worst -> pruned
+        "checkpoint.best_loss_2.50.pt",       # legacy stamp -> pruned
+    ):
+        (tmp_path / name).write_bytes(b"x")
+    src = str(tmp_path / "checkpoint.best_loss_-1.20_20.pt")
+    checkpoint_utils.ckp_copy_fun(src, [src], end_of_epoch=True, args=args)
+    remaining = sorted(os.listdir(tmp_path))
+    assert remaining == [
+        "checkpoint.best_loss_-0.50_10.pt",
+        "checkpoint.best_loss_-1.20_20.pt",
+    ]
+
+
+def test_best_stamp_collision_safe_and_sign_safe():
+    """Two bests rounding to the same {:.2f} stamp must get DISTINCT names
+    (the old stamp silently overwrote the first)."""
+    args = Namespace(
+        no_epoch_checkpoints=True, save_interval=1,
+        save_interval_updates=0, keep_best_checkpoints=2,
+        best_checkpoint_metric="loss", no_last_checkpoints=True,
+    )
+    n1 = checkpoint_utils._checkpoint_names(
+        args, "", epoch=1, updates=100, end_of_epoch=False,
+        val_loss=-1.234, is_new_best=True,
+    )
+    n2 = checkpoint_utils._checkpoint_names(
+        args, "", epoch=1, updates=200, end_of_epoch=False,
+        val_loss=-1.235, is_new_best=True,
+    )
+    (s1,) = [n for n in n1 if n.startswith("checkpoint.best")]
+    (s2,) = [n for n in n2 if n.startswith("checkpoint.best")]
+    assert s1 != s2
+    assert s1 == "checkpoint.best_loss_-1.23_100.pt"
+    # and the retention regex matches the signed stamp
+    rules = checkpoint_utils._retention_rules(_RetainArgs(), end_of_epoch=True)
+    import re
+
+    (pattern, _, _) = rules[0]
+    assert re.fullmatch(pattern, s1)
+
+
+# ---------------------------------------------------------------------------
+# deadline-bounded emergency saves
+# ---------------------------------------------------------------------------
+
+
+class _SaverTrainer:
+    checkpoint_suffix = ""
+    data_parallel_rank = 0
+    should_save_checkpoint_on_current_rank = True
+
+    def save_checkpoint(self, filename, extra_state):
+        checkpoint_utils.persistent_save(
+            {"model": {"w": np.ones(16, np.float32)},
+             "extra_state": extra_state},
+            filename,
+        )
+
+
+class _ItrStub:
+    epoch = 2
+
+    def state_dict(self):
+        return {"epoch": 2, "iterations_in_epoch": 5}
+
+    def end_of_epoch(self):
+        return False
+
+
+def _emergency_args(tmp_path, **over):
+    ns = Namespace(
+        save_dir=str(tmp_path / "save"), tmp_save_dir=str(tmp_path / "tmp"),
+        no_save=False, checkpoint_format="pickle",
+        preemption_save_deadline=5.0,
+    )
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_preemption_deadline_save_is_minimal_and_complete(tmp_path, caplog):
+    """Acceptance: the deadline save finishes a minimal checkpoint_last
+    under a tight budget — one atomic file in save_dir, nothing staged in
+    tmp_save_dir, no best/epoch/interval copies, no best-score update."""
+    args = _emergency_args(tmp_path)
+    with caplog.at_level("INFO"):
+        checkpoint_utils.save_checkpoint(
+            args, _SaverTrainer(), _ItrStub(), 0.75, None, emergency="preempt"
+        )
+    assert sorted(os.listdir(args.save_dir)) == ["checkpoint_last.pt"]
+    assert os.listdir(args.tmp_save_dir) == []
+    assert checkpoint_utils.best_score() is None  # bookkeeping skipped
+
+    state = checkpoint_utils.load_checkpoint_to_cpu(
+        os.path.join(args.save_dir, "checkpoint_last.pt")
+    )
+    es = state["extra_state"]
+    assert es["emergency_save"]["kind"] == "preempt"
+    assert es["train_iterator"] == {"epoch": 2, "iterations_in_epoch": 5}
+    logged = "\n".join(r.message for r in caplog.records)
+    assert "EMERGENCY SAVE" in logged and "over budget" not in logged
+
+
+def test_preemption_deadline_overrun_warns_but_still_lands(tmp_path, caplog):
+    """slow-disk chaos pushes the write past a tiny budget: the checkpoint
+    must STILL land (aborting mid-write would guarantee zero checkpoint)
+    with a loud over-budget diagnosis, and the single-attempt emergency
+    path must not burn the budget on retries/backoff."""
+    chaos.configure(Namespace(fault_inject="slow-disk:0.3@0"))
+    chaos.note_step(1)
+    args = _emergency_args(tmp_path, preemption_save_deadline=0.05)
+    with caplog.at_level("WARNING"):
+        checkpoint_utils.save_checkpoint(
+            args, _SaverTrainer(), _ItrStub(), None, None, emergency="preempt"
+        )
+    assert os.path.exists(os.path.join(args.save_dir, "checkpoint_last.pt"))
+    logged = "\n".join(r.message for r in caplog.records)
+    assert "EMERGENCY SAVE over budget" in logged
+    assert "slow disk" in logged  # the chaos kind announced itself
+
+
+def test_emergency_rename_wins_over_stale_queued_publish(tmp_path):
+    """A publish of an OLDER checkpoint still queued on the async copy
+    pool must not land on checkpoint_last AFTER the emergency save: the
+    emergency path writes its bytes first (inside the budget), drains
+    the pool, and renames last — the freshest state wins."""
+    args = _emergency_args(tmp_path)
+    os.makedirs(args.save_dir, exist_ok=True)
+    os.makedirs(args.tmp_save_dir, exist_ok=True)
+    stale = os.path.join(args.tmp_save_dir, "stale.pt")
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.zeros(4)}, "extra_state": {"stale": True}}, stale
+    )
+    dest = os.path.join(args.save_dir, "checkpoint_last.pt")
+
+    pool = checkpoint_utils.make_copy_pool()
+
+    def slow_publish():
+        time.sleep(0.3)
+        checkpoint_utils._publish_one(stale, dest)
+
+    pool.apply_async(slow_publish)
+    checkpoint_utils.save_checkpoint(
+        args, _SaverTrainer(), _ItrStub(), None, pool, emergency="preempt"
+    )
+    state = checkpoint_utils.load_checkpoint_to_cpu(dest)
+    assert "emergency_save" in state["extra_state"]  # stale copy lost
+    assert not os.path.exists(dest + ".emg")
+
+
+def test_emergency_save_not_blocked_by_parked_async_failure(tmp_path):
+    """A publish failure parked under --on-save-failure abort must NOT
+    abort the preemption save — the one save whose loss is unrecoverable
+    (the process is exiting either way)."""
+    durable.configure(Namespace(on_save_failure="abort"))
+    durable.tracker().note_failure(
+        "checkpoint_best.pt", OSError("EIO"), from_async=True
+    )
+    args = _emergency_args(tmp_path)
+    checkpoint_utils.save_checkpoint(
+        args, _SaverTrainer(), _ItrStub(), None, None, emergency="preempt"
+    )
+    assert os.path.exists(os.path.join(args.save_dir, "checkpoint_last.pt"))
+
+
+def test_emergency_on_error_uses_separate_name_never_auto_resumed(tmp_path):
+    args = _emergency_args(tmp_path, preemption_save_deadline=0.0)
+    checkpoint_utils.save_checkpoint(
+        args, _SaverTrainer(), _ItrStub(), None, None, emergency="error"
+    )
+    assert sorted(os.listdir(args.save_dir)) == ["checkpoint_emergency.pt"]
+    # the crashing state must never be picked up by the resume fallback
+    assert checkpoint_utils._fallback_checkpoints(args.save_dir, "") == []
+
+
+# ---------------------------------------------------------------------------
+# chaos: new storage kinds parse + target the writer rank
+# ---------------------------------------------------------------------------
+
+
+def test_storage_chaos_kinds_parse_and_default_to_writer_rank():
+    for spec, kind, param in (
+        ("bit-flip-checkpoint@10", "bit-flip-checkpoint", None),
+        ("bit-flip-checkpoint:4@10", "bit-flip-checkpoint", 4.0),
+        ("disk-full@5", "disk-full", None),
+        ("slow-disk:2.5@7", "slow-disk", 2.5),
+    ):
+        p = chaos.parse_fault_spec(spec)
+        assert (p.kind, p.param) == (kind, param)
+        assert p.rank == 0  # checkpoints are written by rank 0
+    assert chaos.parse_fault_spec("slow-disk@7@1").rank == 1
+
+
+def test_bit_flip_chaos_flips_exactly_n_payload_bytes(tmp_path):
+    chaos.configure(Namespace(fault_inject="bit-flip-checkpoint:3@0"))
+    chaos.note_step(1)
+    path = str(tmp_path / "checkpoint_last.pt")
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.arange(4096, dtype=np.float32)}}, path
+    )
+    chaos.reset()
+    clean = str(tmp_path / "clean.pt")
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.arange(4096, dtype=np.float32)}}, clean
+    )
+    a = open(path, "rb").read()
+    b = open(clean, "rb").read()
+    assert len(a) == len(b)
+    assert sum(x != y for x, y in zip(a, b)) == 3
+    with pytest.raises(checkpoint_utils.CorruptCheckpointError):
+        checkpoint_utils.load_checkpoint_to_cpu(path)
+
+
+# ---------------------------------------------------------------------------
+# 2-process: verified-load corruption -> agreed multi-host fallback
+# ---------------------------------------------------------------------------
+
+_PREAMBLE = r"""
+import os, sys
+rank = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+_cache = os.environ.get(
+    "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_test_jaxcache"
+)
+if _cache != "0":
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n, process_id=rank)
+sys.path.insert(0, "__REPO__")
+
+from argparse import Namespace
+from unicore_tpu.distributed import chaos, guard
+"""
+
+
+BITFLIP_FALLBACK_WORKER = _PREAMBLE + r"""
+import shutil, time
+import numpy as np
+from unicore_tpu import checkpoint_utils
+
+# per-RANK save dirs: the rotten file exists on rank 1 only, so without
+# the collective agreement rank 0 would happily resume from its intact
+# checkpoint_last while rank 1 falls back — a divergent resume
+save_dir = f"/tmp/unicore_durability_fb_{port}_{rank}"
+shutil.rmtree(save_dir, ignore_errors=True)
+os.makedirs(save_dir, exist_ok=True)
+
+
+def write(name, epoch):
+    checkpoint_utils.persistent_save(
+        {"model": {"w": np.full((64,), float(epoch), np.float32)},
+         "extra_state": {"epoch": epoch}},
+        os.path.join(save_dir, name),
+    )
+    time.sleep(0.05)
+
+
+write("checkpoint_1_100.pt", 1)
+write("checkpoint_1_200.pt", 2)
+if rank == 1:
+    # silent bit rot lands on rank 1's checkpoint_last only
+    chaos.configure(Namespace(fault_inject="bit-flip-checkpoint@0@1"))
+    chaos.note_step(1)
+write("checkpoint_last.pt", 3)
+chaos.reset()
+
+
+class StubTrainer:
+    checkpoint_suffix = ""
+    loaded_path = None
+
+    def load_checkpoint(self, path, *a, **k):
+        if not os.path.exists(path):
+            return None
+        state = checkpoint_utils.load_checkpoint_to_cpu(path)
+        self.loaded_path = path
+        return state.get("extra_state")
+
+
+args = Namespace(save_dir=save_dir, restore_file="checkpoint_last.pt",
+                 finetune_from_model=None, optimizer_overrides="{}",
+                 reset_optimizer=False, reset_lr_scheduler=False,
+                 reset_meters=False, reset_dataloader=False)
+tr = StubTrainer()
+extra = checkpoint_utils.load_checkpoint(args, tr)
+print(f"RANK{rank}_LOADED {os.path.basename(tr.loaded_path)} "
+      f"epoch={extra['epoch']}", flush=True)
+import os as _os
+_os._exit(0)
+"""
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+def _spawn_two(worker_src):
+    port = _free_port()
+    return [
+        subprocess.Popen(
+            [sys.executable, "-c", worker_src.replace("__REPO__", REPO),
+             str(r), "2", port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+
+
+def _drain(procs, timeout=240):
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_bitflip_fallback_stays_in_lockstep():
+    """Acceptance: a single flipped payload byte on ONE host is rejected
+    by the verified load and drags BOTH hosts to the same agreed
+    next-newest retained checkpoint — never a divergent resume."""
+    outs = _drain(_spawn_two(BITFLIP_FALLBACK_WORKER))
+    for r, out in enumerate(outs):
+        assert f"RANK{r}_LOADED checkpoint_1_200.pt epoch=2" in out, (
+            f"rank {r}:\n{out[-5000:]}"
+        )
+    # rank 1 saw the manifest rejection; rank 0 fell back on agreement
+    assert "integrity manifest" in outs[1]
+    assert "CHECKPOINT CORRUPT" in outs[1]
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: bit-flip chaos -> verified-load diagnosis -> resumed run
+# (the CI "Checkpoint-durability chaos smoke" step greps this test's -s
+# output for the corruption diagnosis + successful fallback resume)
+# ---------------------------------------------------------------------------
+
+RUNNER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_compilation_cache_dir", {cache!r})
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+sys.path.insert(0, {repo!r})
+sys.argv = ["train.py"] + {argv!r}
+from unicore_tpu_cli.train import cli_main
+cli_main()
+"""
+
+_JAX_CACHE = os.environ.get(
+    "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_e2e_jaxcache"
+)
+_SCALE = float(os.environ.get("UNICORE_TPU_TEST_TIMEOUT_SCALE", "0")) or (
+    3.0 if (os.cpu_count() or 2) <= 1 else 1.0
+)
+CLI_TIMEOUT = int(600 * _SCALE)
+
+
+def _run_cli(argv):
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         RUNNER.format(repo=REPO, argv=argv, cache=_JAX_CACHE)],
+        capture_output=True,
+        text=True,
+        timeout=CLI_TIMEOUT,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return proc.stdout + proc.stderr
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bert_data")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "examples", "bert", "make_example_data.py"),
+            str(d), "202", "40",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return d
+
+
+def _cli_args(data_dir, save_dir, max_update, extra=()):
+    return [
+        str(data_dir),
+        "--task", "bert", "--loss", "masked_lm", "--arch", "bert_tiny",
+        "--optimizer", "adam", "--lr-scheduler", "polynomial_decay",
+        "--lr", "1e-3", "--warmup-updates", "2",
+        "--total-num-update", str(max_update), "--max-update", str(max_update),
+        "--max-epoch", "10", "--batch-size", "8", "--max-seq-len", "64",
+        "--log-interval", "5", "--log-format", "simple",
+        "--save-dir", os.path.join(save_dir, "ckpt"),
+        "--tmp-save-dir", os.path.join(save_dir, "tmp"),
+        "--num-workers", "0", "--seed", "1", "--no-progress-bar",
+        "--required-batch-size-multiple", "1",
+        "--save-interval-updates", "4", "--keep-interval-updates", "10",
+        "--disable-validation",
+        *extra,
+    ]
+
+
+@pytest.mark.slow
+def test_cli_bitflip_chaos_detected_and_resumed(data_dir, tmp_path):
+    """Acceptance, end to end through the real CLI: run 1 trains to 12
+    updates with bit-flip chaos from step 9 (checkpoints at updates 4/8
+    intact, update 12's interval + last checkpoints silently rotten);
+    run 2 resumes — the verified load rejects BOTH rotten files with the
+    manifest diagnosis, chains the fallback to checkpoint_1_8, and
+    finishes at --max-update 16."""
+    out1 = _run_cli(_cli_args(
+        data_dir, str(tmp_path), 12,
+        extra=["--fault-inject", "bit-flip-checkpoint@9"],
+    ))
+    assert "fault injection ARMED" in out1
+    assert "flipped 1 payload byte" in out1
+    assert os.path.exists(tmp_path / "ckpt" / "checkpoint_last.pt")
+
+    out2 = _run_cli(_cli_args(data_dir, str(tmp_path), 16))
+    print(out2)  # surfaced for the CI chaos-smoke step's grep (pytest -s)
+    assert "integrity manifest digest mismatch" in out2
+    assert "CHECKPOINT CORRUPT" in out2
+    assert "falling back to the next-newest retained checkpoint" in out2
+    # both the torn last AND the rotten interval checkpoint were rejected,
+    # landing on the newest INTACT one (update 8)
+    assert "Loaded checkpoint" in out2
+    assert "@ 8 updates" in out2
+    assert "num_updates: 16" in out2

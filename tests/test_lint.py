@@ -566,6 +566,118 @@ def test_untimed_collective_negative_wrappers_and_lookalikes(tmp_path):
     assert vs == []
 
 
+# ---------------------------------------------------------------------------
+# raw-checkpoint-write
+# ---------------------------------------------------------------------------
+
+
+def test_raw_checkpoint_write_open_and_pickle_dump(tmp_path):
+    """A with-open of a .pt path in write mode, and the pickle.dump into
+    it, both bypass the durable path (positive fixture 1: both shapes)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import pickle
+
+        def save(state, save_dir):
+            with open(save_dir + "/checkpoint_best.pt", "wb") as f:
+                pickle.dump(state, f)
+        """,
+        select=["raw-checkpoint-write"],
+    )
+    assert rule_names(vs) == ["raw-checkpoint-write"] * 2
+    assert "persistent_save" in vs[0].message
+
+
+def test_raw_checkpoint_write_fstring_and_assigned_handle(tmp_path):
+    """f-string .pt tails and handles assigned (not with-bound) from a
+    flagged open are still caught (positive fixture 2)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import pickle
+
+        def save(state, step):
+            f = open(f"ckpts/checkpoint_{step}.pt", mode="wb")
+            pickle.dump(state, f)
+            f.close()
+        """,
+        select=["raw-checkpoint-write"],
+    )
+    assert rule_names(vs) == ["raw-checkpoint-write"] * 2
+
+
+def test_raw_checkpoint_write_negatives(tmp_path):
+    """Reads of .pt files, writes of non-checkpoint extensions, and
+    pickle.dump into non-.pt streams are all fine (negative fixture)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import pickle
+
+        def fine(state, path):
+            with open(path + ".bin", "wb") as f:   # not a checkpoint
+                f.write(b"data")
+            with open("checkpoint_last.pt", "rb") as f:  # a READ
+                state = pickle.load(f)
+            with open(path + ".log", "w") as f:
+                pickle.dump(state, f)  # pickle, but not into a .pt
+            return state
+        """,
+        select=["raw-checkpoint-write"],
+    )
+    assert vs == []
+
+
+def test_raw_checkpoint_write_home_modules_exempt(tmp_path):
+    """unicore_tpu/checkpoint_utils.py and the unicore_tpu/checkpoint/
+    package ARE the durable write path — their raw writes are the
+    implementation.  The exemption is anchored at the unicore_tpu/
+    component: a stray tools/checkpoint/ module or a vendored
+    checkpoint_utils.py copy must NOT ride it."""
+    import textwrap as _tw
+
+    src = _tw.dedent(
+        """
+        import pickle
+
+        def persistent_save(obj, filename):
+            with open(filename + ".pt", "wb") as f:
+                pickle.dump(obj, f)
+        """
+    )
+    home = tmp_path / "unicore_tpu"
+    pkg = home / "checkpoint"
+    pkg.mkdir(parents=True)
+    (home / "checkpoint_utils.py").write_text(src)
+    (pkg / "format.py").write_text(src)
+    vs = lint_paths([str(home)], rules=build_rules(["raw-checkpoint-write"]))
+    assert vs == []
+
+    lookalike = tmp_path / "tools" / "checkpoint"
+    lookalike.mkdir(parents=True)
+    (lookalike / "export.py").write_text(src)
+    (tmp_path / "tools" / "checkpoint_utils.py").write_text(src)
+    vs = lint_paths(
+        [str(tmp_path / "tools")], rules=build_rules(["raw-checkpoint-write"])
+    )
+    assert rule_names(vs) == ["raw-checkpoint-write"] * 4  # 2 files x 2 shapes
+
+
+def test_raw_checkpoint_write_justification_comment(tmp_path):
+    vs = run_lint(
+        tmp_path,
+        """
+        def export_table(rows):
+            # lint: not-a-checkpoint
+            with open("lookup_table.pt", "wb") as f:
+                f.write(rows)
+        """,
+        select=["raw-checkpoint-write"],
+    )
+    assert vs == []
+
+
 def test_untimed_collective_home_module_exempt(tmp_path):
     """distributed/utils.py itself must touch the raw collectives — that is
     where the watchdog wrappers live."""

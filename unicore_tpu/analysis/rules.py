@@ -880,3 +880,138 @@ class UntimedCollective(LintRule):
                     for a in node.names:
                         member_aliases[a.asname or a.name] = a.name
         return mod_aliases, member_aliases
+
+
+# ---------------------------------------------------------------------------
+# 8. raw-checkpoint-write
+# ---------------------------------------------------------------------------
+
+# the sanctioned checkpoint write path: checkpoint_utils.persistent_save
+# and the durable v2 writer it delegates to (unicore_tpu/checkpoint/).
+# Anchored at the unicore_tpu/ component so a stray tools/checkpoint/
+# module or a vendored checkpoint_utils.py copy does NOT ride the
+# exemption (same precision discipline as _COLLECTIVE_HOME above).
+_CHECKPOINT_HOME_FILE = os.path.join("unicore_tpu", "checkpoint_utils.py")
+_CHECKPOINT_HOME_PKG = os.path.join("unicore_tpu", "checkpoint")
+
+
+@register_lint_rule("raw-checkpoint-write")
+class RawCheckpointWrite(LintRule):
+    name = "raw-checkpoint-write"
+    justifications = ("not-a-checkpoint",)
+    description = (
+        "direct pickle.dump / open(..., 'wb') write of a .pt path outside "
+        "checkpoint_utils and the unicore_tpu/checkpoint package: it "
+        "bypasses the durable path (staged fsync'd atomic rename, v2 "
+        "integrity manifest, ENOSPC preflight, save-failure escalation), "
+        "so a crash mid-write tears the file and bit rot goes undetected "
+        "— route the write through checkpoint_utils.persistent_save, or "
+        "justify a genuinely-not-a-checkpoint .pt file with "
+        "'# lint: not-a-checkpoint'"
+    )
+
+    #: open() modes that (over)write; plain "rb" reads stay un-flagged
+    _WRITE_MODE_CHARS = frozenset("wax+")
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        norm = os.path.normpath(module.path)
+        if norm == _CHECKPOINT_HOME_FILE or norm.endswith(
+            os.sep + _CHECKPOINT_HOME_FILE
+        ):
+            return
+        parent = os.path.dirname(norm)
+        if parent == _CHECKPOINT_HOME_PKG or parent.endswith(
+            os.sep + _CHECKPOINT_HOME_PKG
+        ):
+            return
+        #: names with-bound or assigned from a flagged open(): a
+        #: pickle.dump into them is the second shape of the same bypass
+        pt_streams: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.withitem):
+                if (
+                    isinstance(node.context_expr, ast.Call)
+                    and self._is_pt_write_open(node.context_expr)
+                    and isinstance(node.optional_vars, ast.Name)
+                ):
+                    pt_streams.add(node.optional_vars.id)
+            elif isinstance(node, ast.Assign):
+                if (
+                    isinstance(node.value, ast.Call)
+                    and self._is_pt_write_open(node.value)
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            pt_streams.add(t.id)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_pt_write_open(node):
+                yield _v(
+                    self,
+                    module,
+                    node,
+                    "open(..., 'w...') of a checkpoint (.pt) path bypasses "
+                    "the durable write path (fsync'd atomic rename + "
+                    "integrity manifest + save-failure escalation); use "
+                    "checkpoint_utils.persistent_save (or justify with "
+                    "'# lint: not-a-checkpoint')",
+                )
+            elif self._is_pickle_dump_into(node, pt_streams):
+                yield _v(
+                    self,
+                    module,
+                    node,
+                    "pickle.dump into a raw .pt file handle bypasses the "
+                    "durable write path — a crash here leaves a torn "
+                    "checkpoint under the final name and bit rot is never "
+                    "detected; use checkpoint_utils.persistent_save (or "
+                    "justify with '# lint: not-a-checkpoint')",
+                )
+
+    # -- helpers -----------------------------------------------------------
+
+    @classmethod
+    def _is_pt_write_open(cls, call: ast.Call) -> bool:
+        if terminal_name(call.func) != "open" or not call.args:
+            return False
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and cls._WRITE_MODE_CHARS & set(mode.value)
+        ):
+            return False
+        return cls._mentions_pt_path(call.args[0])
+
+    @staticmethod
+    def _mentions_pt_path(node: ast.AST) -> bool:
+        """True when any string constant in the path expression ends with
+        '.pt' — literals, f-string tails, `base + ".pt"` concatenations,
+        os.path.join(..., "x.pt").  Paths built entirely from variables
+        stay un-flagged (heuristic rule, zero-noise bias)."""
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Constant)
+                and isinstance(sub.value, str)
+                and sub.value.endswith(".pt")
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _is_pickle_dump_into(call: ast.Call, pt_streams: Set[str]) -> bool:
+        dotted = dotted_name(call.func)
+        if dotted is None or dotted.split(".")[-1] != "dump":
+            return False
+        if dotted.split(".")[0] != "pickle":
+            return False
+        if len(call.args) < 2:
+            return False
+        stream = call.args[1]
+        return isinstance(stream, ast.Name) and stream.id in pt_streams

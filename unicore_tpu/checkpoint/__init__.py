@@ -1,0 +1,49 @@
+"""Durable-checkpoint subsystem (docs/robustness.md, "Checkpoint
+durability").
+
+Three layers, consumed through :mod:`unicore_tpu.checkpoint_utils` (the
+stable public path — everything importable there stays importable
+there):
+
+* :mod:`~unicore_tpu.checkpoint.format` — checkpoint format v2: header
+  (version, step, config digest, mesh/suffix topology) + a chunked CRC32
+  integrity manifest, verified BEFORE the payload is unpickled, so
+  silent bit rot raises :class:`CorruptCheckpointError` instead of
+  resuming from wrong weights.  v1 pickles and torch ``.pt`` interop are
+  untouched.
+* :mod:`~unicore_tpu.checkpoint.durable` — fsync discipline (staged file
+  AND parent directory), atomic single-file publishes, ENOSPC preflight,
+  optional read-back verification, and the ``--on-save-failure`` terminal
+  escalation ladder with its consecutive-failure counter.
+* :mod:`~unicore_tpu.checkpoint.emergency` — the deadline scope behind
+  ``--preemption-save-deadline`` and the fatal-exception emergency save.
+"""
+
+from unicore_tpu.checkpoint.format import (  # noqa: F401
+    DEFAULT_CHUNK_SIZE,
+    MAGIC,
+    CorruptCheckpointError,
+    is_v2,
+    payload_bounds,
+    read,
+    read_header,
+    verify,
+    write,
+)
+from unicore_tpu.checkpoint.durable import (  # noqa: F401
+    CheckpointWriteError,
+    SaveFailureTracker,
+    SavePolicy,
+    atomic_publish_file,
+    estimate_state_nbytes,
+    fsync_dir,
+    preflight_free_space,
+    save_failure_token,
+    save_policy,
+    tracker,
+)
+from unicore_tpu.checkpoint.emergency import (  # noqa: F401
+    Deadline,
+    active_deadline,
+    deadline_scope,
+)

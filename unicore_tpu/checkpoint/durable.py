@@ -1,0 +1,277 @@
+"""Durable-write policy: fsync discipline, ENOSPC preflight, read-back
+verification, and the save-failure escalation ladder.
+
+``persistent_save`` (checkpoint_utils) consults the process-global
+:class:`SavePolicy` configured from the parsed args.  Terminal save
+failures are no longer fire-and-forget: every one feeds the
+:class:`SaveFailureTracker`'s consecutive-failure counter,
+``--on-save-failure abort`` turns them into a raised
+:class:`CheckpointWriteError`, and the counter rides the consistency
+guard's fingerprint (``save_health``) so a run whose checkpoints have
+silently stopped landing is visible in every watchdog stall dump and
+operator gather — a training job that "finishes" with zero durable
+checkpoints is a total loss that *looked* healthy the whole way.
+"""
+
+import dataclasses
+import errno
+import logging
+import os
+import shutil
+import threading
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointWriteError(RuntimeError):
+    """A checkpoint write failed terminally and ``--on-save-failure
+    abort`` escalated it (or the ENOSPC preflight refused to start a
+    write that could not finish)."""
+
+
+# ---------------------------------------------------------------------------
+# policy (configured once from args; defaults match a bare library call)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SavePolicy:
+    #: 2 = manifest-verified envelope (checkpoint/format.py); 1 = legacy
+    #: bare pickle for tools that predate the manifest.  Both read back.
+    write_version: int = 2
+    #: re-open and CRC-verify every staged write before it is trusted
+    #: (--verify-checkpoint-writes): catches storage that acknowledges
+    #: writes it corrupted, at the cost of one extra read pass
+    verify_writes: bool = False
+    #: what a TERMINAL save failure does: "warn" logs and trains on
+    #: (the reference's fire-and-forget semantics), "abort" raises
+    #: CheckpointWriteError into the training loop
+    on_save_failure: str = "warn"
+
+
+_policy = SavePolicy()
+
+
+def save_policy() -> SavePolicy:
+    return _policy
+
+
+def configure(args) -> SavePolicy:
+    """Install the durable-write policy from parsed args (idempotent)."""
+    global _policy
+    _policy = SavePolicy(
+        write_version=int(getattr(args, "checkpoint_write_version", 2) or 2),
+        verify_writes=bool(getattr(args, "verify_checkpoint_writes", False)),
+        on_save_failure=str(
+            getattr(args, "on_save_failure", "warn") or "warn"
+        ),
+    )
+    if _policy.verify_writes and _policy.write_version < 2:
+        logger.warning(
+            "--verify-checkpoint-writes has NOTHING to verify under "
+            "--checkpoint-write-version 1: the legacy bare pickle carries "
+            "no integrity manifest, so every read-back pass is skipped — "
+            "drop one of the two flags"
+        )
+    return _policy
+
+
+def reset() -> None:
+    """Clear process-global policy + tracker state (tests)."""
+    global _policy, _tracker
+    _policy = SavePolicy()
+    _tracker = SaveFailureTracker()
+
+
+# ---------------------------------------------------------------------------
+# fsync discipline
+# ---------------------------------------------------------------------------
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives power loss — the
+    rename itself lives in directory metadata, and an unsynced parent can
+    forget the new name (or remember it pointing at unsynced blocks).
+    Best-effort: filesystems that refuse directory fds (some network
+    mounts, non-POSIX hosts) degrade to the pre-durability behavior."""
+    if os.name != "posix":
+        return
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_publish_file(src: str, dst: str) -> None:
+    """Copy ``src`` to the final name ``dst`` via a fsync'd sibling-staging
+    rename, so a crash mid-copy can never leave a torn file under the
+    final name (the torn-``checkpoint_best.pt`` bug: a plain
+    ``shutil.copyfile`` straight onto ``dst`` destroys the previous good
+    checkpoint the moment it truncates the target)."""
+    staging = dst + ".tmp"
+    shutil.copyfile(src, staging)
+    with open(staging, "rb") as f:
+        try:
+            os.fsync(f.fileno())
+        except OSError:
+            pass
+    os.replace(staging, dst)
+    fsync_dir(os.path.dirname(dst))
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC preflight
+# ---------------------------------------------------------------------------
+
+def estimate_state_nbytes(obj: Any) -> int:
+    """Cheap lower-bound estimate of the pickled size of a checkpoint
+    state: array leaves dominate and their buffers pickle ~1:1; container
+    overhead and scalars ride a per-node fudge."""
+    total = 0
+    stack = [obj]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, np.ndarray):
+            total += int(node.nbytes)
+        elif isinstance(node, memoryview):
+            total += node.nbytes  # len() counts ELEMENTS on typed views
+        elif isinstance(node, (bytes, bytearray)):
+            total += len(node)
+        elif isinstance(node, str):
+            total += len(node.encode("utf-8", "surrogatepass"))
+        elif isinstance(node, dict):
+            stack.extend(node.keys())
+            stack.extend(node.values())
+            total += 64
+        elif isinstance(node, (list, tuple, set, frozenset)):
+            stack.extend(node)
+            total += 64
+        else:
+            total += 64
+    return total
+
+
+def preflight_free_space(directory: str, need_bytes: int) -> None:
+    """Refuse to START a write the filesystem cannot finish: a checkpoint
+    that ENOSPCs halfway leaves a torn ``.tmp`` AND may have pushed the
+    disk to 100%, taking the retention pruner's ability to help down with
+    it.  5% + 1 MiB headroom covers pickle framing and the v2 envelope.
+    Unstat-able filesystems skip the preflight (the write itself will
+    report honestly)."""
+    try:
+        free = shutil.disk_usage(directory or ".").free
+    except OSError:
+        return
+    margin = int(need_bytes * 1.05) + (1 << 20)
+    if free < margin:
+        raise CheckpointWriteError(
+            f"ENOSPC preflight: ~{margin} bytes needed for the checkpoint "
+            f"but only {free} free in {directory or '.'} — refusing to "
+            "start a write that cannot finish (free disk or lower the "
+            "checkpoint cadence/retention)"
+        )
+
+
+def is_enospc(err: BaseException) -> bool:
+    return isinstance(err, OSError) and err.errno == errno.ENOSPC
+
+
+def drop_page_cache(path: str) -> None:
+    """Best-effort eviction of ``path`` from the OS page cache, so a
+    read-back verification actually exercises storage instead of
+    re-reading the just-written pages out of RAM (which would pass even
+    when the media corrupted the bytes it ACKed)."""
+    if not hasattr(os, "posix_fadvise"):
+        return
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# save-failure escalation
+# ---------------------------------------------------------------------------
+
+class SaveFailureTracker:
+    """Counts terminal checkpoint-save failures.  ``consecutive`` resets
+    on the next successful save; ``total`` never does.  Failures noted
+    from the async publish pool (which must never raise) are parked and
+    escalated at the NEXT save on the training thread.  Counter updates
+    are lock-guarded: the pool thread's ``note_failure`` races the
+    training thread's ``escalate_pending`` read-then-clear, and an
+    unguarded increment landing between the two would silently drop a
+    parked failure the abort policy promised to surface."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.consecutive = 0
+        self.total = 0
+        self.last_error: Optional[str] = None
+        self.last_path: Optional[str] = None
+        self._async_pending = 0
+
+    def note_failure(self, path: str, err: BaseException,
+                     from_async: bool = False) -> None:
+        with self._lock:
+            self.consecutive += 1
+            self.total += 1
+            self.last_error = f"{type(err).__name__}: {err}"
+            self.last_path = path
+            if from_async:
+                self._async_pending += 1
+            consecutive, total = self.consecutive, self.total
+        logger.error(
+            f"CHECKPOINT SAVE FAILED ({consecutive} consecutive, "
+            f"{total} total this run): {path} ({self.last_error})"
+        )
+
+    def note_success(self) -> None:
+        with self._lock:
+            self.consecutive = 0
+
+    def token(self) -> Optional[Tuple[int, int]]:
+        """(consecutive, total) once any save has failed, else None.
+        Rides the consistency-guard fingerprint as ``save_health``."""
+        with self._lock:
+            if self.total == 0:
+                return None
+            return (self.consecutive, self.total)
+
+    def escalate_pending(self) -> None:
+        """Raise for failures parked by the async publish pool, when the
+        policy says abort.  Called from the training thread at the start
+        of every save — the pool itself must never raise."""
+        with self._lock:
+            pending = self._async_pending
+            self._async_pending = 0
+        if pending and _policy.on_save_failure == "abort":
+            raise CheckpointWriteError(
+                f"{pending} checkpoint publish(es) failed on the async "
+                f"copy pool (last: {self.last_path}: {self.last_error}) "
+                "and --on-save-failure abort is set"
+            )
+
+
+_tracker = SaveFailureTracker()
+
+
+def tracker() -> SaveFailureTracker:
+    return _tracker
+
+
+def save_failure_token() -> Optional[Tuple[int, int]]:
+    return _tracker.token()

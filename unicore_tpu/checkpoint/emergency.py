@@ -1,0 +1,65 @@
+"""Deadline-bounded emergency saves.
+
+A preemption notice (SIGTERM) comes with a grace budget measured in
+seconds; a full save — stage in ``--tmp-save-dir``, publish every name,
+prune retention, optionally read-back-verify — can blow it and leave NO
+checkpoint at all.  ``--preemption-save-deadline SECS`` arms the minimal
+path: write ONE fsync'd ``checkpoint_last`` directly into ``--save-dir``
+and skip everything optional.  The :class:`Deadline` is exposed through a
+process-global scope that ``persistent_save`` consults to drop its
+retry/backoff ladder and read-back verification — retries eat a budget
+that only exists once.
+
+The deadline is advisory at the write layer: once the single write has
+started it runs to completion (aborting mid-write would guarantee zero
+checkpoint, strictly worse than finishing late), and an over-budget
+finish logs a loud warning so the operator learns the budget is unreal
+BEFORE the preemption where it matters.
+"""
+
+import contextlib
+import math
+import time
+from typing import Optional
+
+class Deadline:
+    """Monotonic countdown from construction.  ``budget=None`` never
+    expires (used for the fatal-exception emergency save, which has no
+    external grace period but wants the same minimal write path)."""
+
+    def __init__(self, budget: Optional[float] = None):
+        # `is not None`, not truthiness: an explicit budget of 0 means
+        # "already expired", not "never expires"
+        self.budget = float(budget) if budget is not None else None
+        self._t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def remaining(self) -> float:
+        if self.budget is None:
+            return math.inf
+        return self.budget - self.elapsed()
+
+    def exceeded(self) -> bool:
+        return self.remaining() <= 0
+
+
+_active: Optional[Deadline] = None
+
+
+def active_deadline() -> Optional[Deadline]:
+    """The emergency deadline currently in scope, else None.  A non-None
+    value tells the write layer it is inside an emergency save: one
+    attempt, no backoff, no read-back verification."""
+    return _active
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Deadline):
+    global _active
+    prev, _active = _active, deadline
+    try:
+        yield deadline
+    finally:
+        _active = prev

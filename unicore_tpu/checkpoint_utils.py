@@ -6,10 +6,23 @@ last), regex-driven retention pruning, atomic tmp+rename writes staged in
 ``--tmp-save-dir`` with an async copy thread to ``--save-dir``,
 ``--finetune-from-model`` reset semantics, writability probe.
 
-Format: pickled dict whose array leaves are numpy (device arrays are
+Format: a pickled dict whose array leaves are numpy (device arrays are
 gathered with ``jax.device_get`` before save) — torch-free, readable from
-any host.  A one-way torch ``.pt`` -> pytree converter is provided for
-importing Uni-Core / Uni-Mol weights (SURVEY.md §7 'checkpoint interop').
+any host — wrapped, by default, in the **format v2** envelope
+(``unicore_tpu/checkpoint/format.py``): a header carrying the step /
+config digest / mesh topology plus a chunked CRC32 integrity manifest
+that is verified BEFORE the payload is unpickled, so silent bit rot
+raises :class:`CorruptCheckpointError` into the multi-host resume
+fallback instead of resuming from wrong weights.  v1 (bare-pickle)
+checkpoints still load transparently, and the two-way torch ``.pt``
+interop for Uni-Core / Uni-Mol weights (SURVEY.md §7) is unchanged.
+
+Writes are durable (docs/robustness.md "Checkpoint durability"): staged
+file AND parent directory fsync'd before the atomic rename, single-file
+publishes stage-and-swap, an ENOSPC preflight refuses writes that cannot
+finish, ``--verify-checkpoint-writes`` read-back-verifies each staged
+write, and terminal failures escalate per ``--on-save-failure`` instead
+of being fire-and-forget.
 """
 
 import ast
@@ -24,6 +37,14 @@ from multiprocessing.pool import ThreadPool
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+from unicore_tpu.checkpoint import (
+    durable as _durable,
+    emergency as _emergency,
+    format as _format,
+)
+from unicore_tpu.checkpoint.durable import CheckpointWriteError  # noqa: F401
+from unicore_tpu.checkpoint.format import CorruptCheckpointError
 
 logger = logging.getLogger(__name__)
 
@@ -78,11 +99,15 @@ def _remove_checkpoint(path):
 
 
 def _publish_one(src, dst):
-    """Materialize ``src`` under the final name ``dst``.  Directory
-    checkpoints (orbax) go through a stage-and-swap so a preemption mid-copy
-    never destroys the previous checkpoint under ``dst``."""
+    """Materialize ``src`` under the final name ``dst`` via stage-and-swap
+    so a preemption mid-copy never destroys the previous checkpoint under
+    ``dst``.  Single files used to land through a plain
+    ``shutil.copyfile`` straight onto the final name — a crash mid-copy
+    left a TORN ``checkpoint_best.pt``/``checkpoint_last.pt`` where a good
+    one used to be; they now stage to a fsync'd sibling ``.tmp`` and
+    rename, mirroring the directory (orbax) path."""
     if not os.path.isdir(src):
-        shutil.copyfile(src, dst)
+        _durable.atomic_publish_file(src, dst)
         return
     staging = dst + ".tmp"
     if os.path.lexists(staging):
@@ -103,7 +128,12 @@ def _retention_rules(args, end_of_epoch):
     if args.keep_last_epochs >= 0:
         rules.append((r"checkpoint(\d+)\.pt", args.keep_last_epochs, True))
     if args.keep_best_checkpoints > 0:
-        metric_pat = r"checkpoint\.best_{}_(\d+\.?\d*)\.pt".format(
+        # sign-safe: the stamp writes e.g. -1.23 and the old (\d...) group
+        # could never match a minus sign, so negative-metric best files
+        # accumulated forever.  The trailing _UPDATES disambiguator (see
+        # _checkpoint_names) is optional so pre-existing stamps still
+        # prune.
+        metric_pat = r"checkpoint\.best_{}_(-?\d+\.?\d*)(?:_\d+)?\.pt".format(
             args.best_checkpoint_metric
         )
         # keep the TOP of the score ordering: for minimized metrics the
@@ -128,7 +158,11 @@ def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
             logger.info(f"copy {src} to {dst}")
             _publish_one(src, dst)
             published += 1
-        except Exception:
+        except Exception as e:
+            # this runs on the async pool and must never raise; the
+            # failure is PARKED in the tracker and escalated on the
+            # training thread at the next save (--on-save-failure abort)
+            _durable.tracker().note_failure(dst, e, from_async=True)
             logger.info("copy failed, please copy it manually")
 
     try:
@@ -173,10 +207,13 @@ def _checkpoint_names(args, suffix, epoch, updates, end_of_epoch, val_loss,
     if is_new_best:
         names.append(f"checkpoint_best{suffix}.pt")
         if args.keep_best_checkpoints > 0:
-            # score-stamped name so retention can rank best checkpoints
+            # score-stamped name so retention can rank best checkpoints.
+            # The update count disambiguates scores that round to the same
+            # {:.2f} stamp (collision-safe: two distinct bests no longer
+            # silently overwrite each other under one name).
             names.append(
-                "checkpoint.best_{}_{:.2f}.pt".format(
-                    args.best_checkpoint_metric, val_loss
+                "checkpoint.best_{}_{:.2f}_{}.pt".format(
+                    args.best_checkpoint_metric, val_loss, updates
                 )
             )
     if not args.no_last_checkpoints:
@@ -185,12 +222,33 @@ def _checkpoint_names(args, suffix, epoch, updates, end_of_epoch, val_loss,
 
 
 def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
-                    do_save=True):
+                    do_save=True, emergency=None):
+    """``emergency`` selects the deadline-bounded minimal path:
+    ``"preempt"`` (SIGTERM with ``--preemption-save-deadline``) writes a
+    minimal ``checkpoint_last`` directly into save_dir; ``"error"``
+    (``--emergency-save-on-error`` on a fatal trainer exception) writes
+    ``checkpoint_emergency`` — a separate name, because the crashing
+    state may itself be the problem and must not clobber the last known
+    good ``checkpoint_last`` nor be auto-resumed."""
     # every rank evaluates the best-score update so the module state stays
     # in sync; only the writing rank touches the filesystem
     if trainer.data_parallel_rank == 0:
         os.makedirs(args.save_dir, exist_ok=True)
         os.makedirs(args.tmp_save_dir, exist_ok=True)
+
+    if emergency is not None:
+        # NO escalation on this path: a parked async-publish failure must
+        # not abort the preemption/crash save — the one save whose loss
+        # is unrecoverable (the process is exiting either way)
+        if args.no_save or not do_save:
+            return
+        return _emergency_save_checkpoint(
+            args, trainer, epoch_itr, val_loss, emergency, ckp_copy_thread
+        )
+
+    # publish failures parked by the async copy pool escalate HERE, on the
+    # training thread, when --on-save-failure abort is set
+    _durable.tracker().escalate_pending()
 
     is_new_best = _track_best(args, val_loss)
 
@@ -229,9 +287,20 @@ def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
     final_paths = [os.path.join(args.save_dir, n) for n in names]
 
     write_started = time.monotonic()
-    trainer.save_checkpoint(staged, extra_state)
+    saved = trainer.save_checkpoint(staged, extra_state)
     if not trainer.should_save_checkpoint_on_current_rank:
         return  # non-zero ranks only participate in the collective write
+    if saved is False:
+        # terminal write failure under --on-save-failure warn (abort
+        # raised out of persistent_save already): the staged file was
+        # cleaned up, so publishing would either FileNotFoundError on
+        # every final name or, worse, re-publish a STALE same-named
+        # staged file over checkpoint_last/checkpoint_best
+        logger.error(
+            f"skipping checkpoint publish for epoch {epoch} @ {updates} "
+            f"updates: the staged write {staged} did not land"
+        )
+        return
 
     publish = (staged, final_paths, end_of_epoch, args)
     if ckp_copy_thread is not None:
@@ -243,6 +312,85 @@ def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
         f"score {val_loss}) "
         f"(writing took {time.monotonic() - write_started} seconds)"
     )
+
+
+def _emergency_save_checkpoint(args, trainer, epoch_itr, val_loss, kind,
+                               ckp_copy_thread=None):
+    """Deadline-bounded minimal save (docs/robustness.md): ONE fsync'd
+    atomic write of ``checkpoint_last`` (``kind="preempt"``) or
+    ``checkpoint_emergency`` (``kind="error"``) directly into save_dir —
+    no tmp-dir staging hop, no publish copies, no best-score bookkeeping,
+    no retention pruning, no read-back verification, no retry/backoff
+    (retries eat a grace budget that only exists once).
+
+    Ordering matters twice over: the minimal state is written to a
+    STAGED sibling first, *inside* the budget (an orbax directory save
+    writes in place, so staging also protects the previous good
+    ``checkpoint_last`` from its initial rmtree); only once the bytes
+    are durable is the async publish pool drained (a queued publish of
+    an OLDER staged checkpoint must not land on ``checkpoint_last``
+    after we do — but draining FIRST could eat the whole SIGTERM grace
+    behind a slow copy and lose the save entirely); the atomic rename
+    publishes last.  A kill mid-drain leaves the staged ``.emg`` file on
+    disk for manual salvage.  The deadline is advisory past the point
+    the single write starts: aborting mid-write would guarantee zero
+    checkpoint, strictly worse than finishing late — an over-budget
+    finish logs loudly instead."""
+    collective = getattr(args, "checkpoint_format", "pickle") == "orbax"
+    if not collective and not trainer.should_save_checkpoint_on_current_rank:
+        return
+    budget = float(getattr(args, "preemption_save_deadline", 0) or 0)
+    deadline = _emergency.Deadline(
+        budget if (kind == "preempt" and budget > 0) else None
+    )
+    base = "checkpoint_last" if kind == "preempt" else "checkpoint_emergency"
+    name = f"{base}{trainer.checkpoint_suffix}.pt"
+    dest = os.path.join(args.save_dir, name)
+    staged = dest + ".emg"
+    extra_state = {
+        "train_iterator": epoch_itr.state_dict(),
+        "val_loss": val_loss,
+        "emergency_save": {"kind": kind, "deadline": budget or None},
+    }
+    if _best_score is not None:
+        extra_state["best"] = _best_score
+    logger.warning(
+        f"EMERGENCY SAVE ({kind}): writing minimal {name}"
+        + (f" inside a {budget:.1f}s budget" if deadline.budget else "")
+    )
+    with _emergency.deadline_scope(deadline):
+        saved = trainer.save_checkpoint(staged, extra_state)
+    elapsed = deadline.elapsed()  # budget accounting ends with the write
+    publisher = (
+        getattr(trainer, "is_data_parallel_master", True)
+        if collective
+        else trainer.should_save_checkpoint_on_current_rank
+    )
+    if saved is not False and publisher:
+        if ckp_copy_thread is not None:
+            ckp_copy_thread.close()
+            ckp_copy_thread.join()
+        _remove_checkpoint(dest)
+        os.rename(staged, dest)
+        _durable.fsync_dir(args.save_dir)
+    if saved is False:
+        logger.error(
+            f"EMERGENCY SAVE FAILED: {name} did not land after "
+            f"{elapsed:.1f}s — exiting WITHOUT a final checkpoint"
+        )
+    elif deadline.budget and elapsed > deadline.budget:
+        logger.warning(
+            f"EMERGENCY SAVE over budget: {name} took {elapsed:.1f}s "
+            f"against --preemption-save-deadline {deadline.budget:.1f}s — "
+            "the checkpoint landed, but raise the deadline (or shrink the "
+            "state) before the next preemption cuts it off for real"
+        )
+    else:
+        logger.info(
+            f"EMERGENCY SAVE: wrote minimal {name} in {elapsed:.1f}s "
+            "(skipped publish copies, best-score bookkeeping, retention, "
+            "and read-back verification)"
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -305,16 +453,11 @@ def _resolve_restore(args, suffix):
     return path, resets
 
 
-class CorruptCheckpointError(RuntimeError):
-    """The checkpoint FILE could not be read or decoded — torn write, bit
-    rot, or failing storage.  Raised by :func:`load_checkpoint_to_cpu` for
-    ANY parse/read failure (bit-flipped pickles throw OverflowError,
-    ValueError, AttributeError, ... — an open set no tuple can cover), so
-    the resume fallback keys on the file layer, while genuine operator
-    errors AFTER a successful parse (shape mismatches in merge_params,
-    unknown optimizers) still crash loudly with their own types."""
-
-
+# CorruptCheckpointError lives in unicore_tpu/checkpoint/format.py (the
+# v2 verifier raises it for manifest digest mismatches; the parse-layer
+# wrapper below raises it for every legacy read/decode failure) and is
+# re-exported here — the stable public path.
+#
 # What a damaged checkpoint raises to load_checkpoint's fallback loop:
 # the parse-layer wrapper above, plus read-I/O failures (EIO, stale NFS
 # handles) from paths that bypass load_checkpoint_to_cpu (orbax restores).
@@ -481,13 +624,31 @@ def load_checkpoint(args, trainer, **passthrough_args):
 def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
     """Load a checkpoint into host memory (reference checkpoint_utils.py:244-258).
 
-    Transparently reads either this framework's pickle format or a torch
-    ``.pt`` checkpoint (converted on the fly via :func:`torch_to_pytree`).
+    Transparently reads this framework's manifest-verified v2 format, its
+    legacy v1 pickle format, or a torch ``.pt`` checkpoint (converted on
+    the fly via :func:`torch_to_pytree`).  v2 loads are VERIFIED: every
+    payload chunk's CRC32 is checked against the integrity manifest
+    before the payload is unpickled, so a flipped byte that would have
+    unpickled into silently wrong weights raises
+    :class:`CorruptCheckpointError` into the resume-fallback ladder
+    instead.
     """
     import sys
 
     try:
-        if detect_checkpoint_format(path) == "torch":
+        fmt = detect_checkpoint_format(path)
+        if fmt == "v2":
+            header, state = _format.read(path, verify_payload=True)
+            logger.info(
+                f"checkpoint manifest verified: {path} (v2, "
+                f"step {header.get('step', '?')}, "
+                f"config {header.get('config_digest', '?')})"
+            )
+            # a torch-using task may have tucked tensors into task_state;
+            # same conversion discipline as the plain-pickle path below
+            if "torch" in sys.modules and _has_torch_tensors(state):
+                state = torch_to_pytree(state)
+        elif fmt == "torch":
             try:
                 state = load_torch_checkpoint(path)
             except Exception as torch_err:
@@ -534,6 +695,10 @@ def load_checkpoint_to_cpu(path, arg_overrides=None, load_on_all_ranks=True):
                     not torch_was_loaded or _has_torch_tensors(state)
                 ):
                     state = torch_to_pytree(state)
+    except CorruptCheckpointError:
+        # already classified by the v2 verifier (manifest mismatch, torn
+        # envelope) — re-wrapping would bury the digest diagnosis
+        raise
     except Exception as e:
         # ANY read/parse failure is file damage as far as callers are
         # concerned — bit-flipped pickles throw an open set of types
@@ -557,8 +722,9 @@ _LEGACY_TORCH_MAGIC = (0x1950A86A20F9469CFC6C).to_bytes(10, "little")
 
 
 def detect_checkpoint_format(path) -> str:
-    """``"torch"`` or ``"pickle"``, from the file header only (no
-    unpickling — a native checkpoint can be multi-GB).  torch >= 1.6
+    """``"v2"``, ``"torch"``, or ``"pickle"``, from the file header only
+    (no unpickling — a native checkpoint can be multi-GB).  The native v2
+    envelope leads with its own 8-byte magic.  torch >= 1.6
     zipfiles carry the b'PK' magic; LEGACY torch files start with a pickle
     of torch's magic-number long under WHATEVER protocol the writer chose
     (torch.save defaults to 2 but accepts ``pickle_protocol``): PROTO n,
@@ -569,6 +735,8 @@ def detect_checkpoint_format(path) -> str:
     way: ``load_checkpoint_to_cpu`` retries the other loader on failure."""
     with open(path, "rb") as f:
         head = f.read(32)
+    if head[: len(_format.MAGIC)] == _format.MAGIC:
+        return "v2"
     long1_magic = b"\x8a\x0a" + _LEGACY_TORCH_MAGIC
     legacy = (
         len(head) >= 2
@@ -693,30 +861,110 @@ def checkpoint_paths(path, pattern=r"checkpoint(\d+)\.pt"):
     return [os.path.join(path, name) for _, name in hits]
 
 
-def persistent_save(obj, filename, attempts=3, backoff=0.5):
-    """Atomic pickle save — write to a sibling tmp name, then rename over
-    the target so readers never see a torn file.  Transient filesystem
-    errors (e.g. NFS blips) get retries with exponential backoff
-    (``backoff * 2**attempt`` seconds between tries — an NFS blip that
-    survives an immediate retry usually clears within seconds); the last
-    failure is logged rather than raised, matching the reference's
-    fire-and-forget save semantics (torch_persistent_save)."""
+def persistent_save(obj, filename, attempts=3, backoff=0.5, meta=None):
+    """Durable atomic save — the only sanctioned checkpoint write path
+    (enforced by the ``raw-checkpoint-write`` lint rule).
+
+    Stages a sibling ``.tmp``, fsyncs the file AND its parent directory,
+    then renames over the target so readers never see a torn file AND a
+    power loss cannot forget the rename.  By default the payload is
+    wrapped in the manifest-verified v2 envelope (``meta`` lands in its
+    header; ``--checkpoint-write-version 1`` restores the legacy bare
+    pickle).  An ENOSPC preflight refuses to start a write the disk
+    cannot finish, and ``--verify-checkpoint-writes`` re-reads and
+    CRC-verifies the staged file before it is trusted.
+
+    Transient filesystem errors (e.g. NFS blips) get retries with
+    exponential backoff (``backoff * 2**attempt`` seconds between tries);
+    ENOSPC skips the retries (a full disk does not blip clear).  A
+    TERMINAL failure feeds the save-failure tracker's consecutive-failure
+    counter (which rides the consistency-guard fingerprint as
+    ``save_health``) and then follows ``--on-save-failure``: ``warn``
+    logs and returns False (the reference's fire-and-forget
+    torch_persistent_save semantics), ``abort`` raises
+    :class:`CheckpointWriteError`.  Returns True once the write landed.
+
+    Inside an emergency deadline scope (``--preemption-save-deadline``)
+    retries, backoff, and read-back verification are dropped — they eat a
+    grace budget that only exists once."""
     from unicore_tpu.distributed import chaos
 
+    policy = _durable.save_policy()
+    deadline = _emergency.active_deadline()
+    if deadline is not None:
+        attempts = 1
     scratch = filename + ".tmp"
+    directory = os.path.dirname(filename)
+
+    def _terminal_failure(err):
+        _durable.tracker().note_failure(filename, err)
+        try:
+            if os.path.lexists(scratch):
+                os.remove(scratch)  # never leave a torn .tmp eating disk
+        except OSError:
+            pass
+        if policy.on_save_failure == "abort":
+            raise CheckpointWriteError(
+                f"checkpoint save to {filename} failed terminally "
+                f"({type(err).__name__}: {err}) and --on-save-failure "
+                "abort is set"
+            ) from err
+        logger.error(
+            f"checkpoint save to {filename} failed terminally; training "
+            "continues WITHOUT a fresh checkpoint (--on-save-failure "
+            "warn):\n" + traceback.format_exc()
+        )
+        return False
+
+    try:
+        _durable.preflight_free_space(
+            directory, _durable.estimate_state_nbytes(obj)
+        )
+    except CheckpointWriteError as e:
+        if policy.on_save_failure == "abort":
+            _durable.tracker().note_failure(filename, e)
+            raise
+        return _terminal_failure(e)
+
     for attempt in range(attempts):
         try:
-            with open(scratch, "wb") as f:
-                pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+            chaos.maybe_slow_disk(filename)
+            chaos.maybe_disk_full(filename)
+            if policy.write_version >= 2:
+                _format.write(obj, scratch, meta=meta)
+            else:
+                with open(scratch, "wb") as f:
+                    pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    os.fsync(f.fileno())
+            if (
+                policy.verify_writes
+                and deadline is None
+                and _format.is_v2(scratch)
+            ):
+                # read-back verification of the STAGED file, before the
+                # rename publishes it: catches storage that ACKed bytes
+                # it corrupted while the previous good checkpoint still
+                # lives untouched under the final name (verifying after
+                # the rename would have already destroyed it) and while
+                # the data is still in RAM to rewrite — a verify failure
+                # below retries the whole write.  The page cache is
+                # dropped first so the CRC pass reads the MEDIA, not the
+                # kernel's still-resident copy of what we just wrote.
+                _durable.drop_page_cache(scratch)
+                _format.verify(scratch)
             os.rename(scratch, filename)
-            # chaos truncate-checkpoint: simulate a torn write that slipped
-            # past the atomic rename (pairs with the resume fallback)
+            _durable.fsync_dir(directory)
+            # chaos at-rest damage LAST — it must slip past every
+            # write-side check, exactly like real bit rot (pairs with the
+            # verified load + resume fallback)
             chaos.maybe_truncate_checkpoint(filename)
-            return
-        except Exception:
-            if attempt == attempts - 1:
-                logger.error(traceback.format_exc())
-                return
+            chaos.maybe_bit_flip_checkpoint(filename)
+            _durable.tracker().note_success()
+            return True
+        except Exception as e:
+            if attempt == attempts - 1 or _durable.is_enospc(e):
+                return _terminal_failure(e)
             delay = backoff * (2 ** attempt)
             logger.warning(
                 f"checkpoint write to {filename} failed (attempt "

@@ -24,6 +24,19 @@ Kinds (all persistent from STEP onward unless noted):
     Checkpoint files written by the targeted rank are truncated to half
     after the atomic rename — the torn-file case the resume fallback
     (checkpoint_utils.load_checkpoint) must survive.
+``bit-flip-checkpoint[:NBYTES]``
+    NBYTES (default 1) payload bytes of each checkpoint the targeted rank
+    writes are bit-flipped AFTER the write passed every write-side check —
+    silent bit rot at rest.  A v1 pickle usually still unpickles (into
+    wrong weights); the v2 integrity manifest must reject it at load with
+    ``CorruptCheckpointError`` so the resume fallback engages.
+``disk-full``
+    Checkpoint write attempts on the targeted rank raise ENOSPC — proves
+    the terminal-failure escalation ladder (``--on-save-failure``).
+``slow-disk[:SECS]``
+    Checkpoint writes on the targeted rank stall SECS (default 5) before
+    touching the disk — proves the deadline-bounded emergency save path
+    (``--preemption-save-deadline``) and its over-budget diagnosis.
 ``raise``
     Raises :class:`ChaosError` out of ``train_step`` at exactly STEP
     (one-shot), exercising crash paths (--suppress-crashes, sweep drivers).
@@ -45,9 +58,10 @@ For the rank-targetable kinds, RANK defaults to the LAST process (rank
 ``process_count - 1``): on a 2-host cluster the fault lands on rank 1
 while rank 0 — coordinator and checkpoint writer — stays healthy to
 report the diagnosis; single-host runs target rank 0 so every kind stays
-testable without a cluster.  Exception: ``truncate-checkpoint`` defaults
-to rank 0, the only rank that writes checkpoints — targeting the last
-rank would be a silent no-op on multi-host runs.
+testable without a cluster.  Exception: the checkpoint-storage kinds
+(``truncate-checkpoint``, ``bit-flip-checkpoint``, ``disk-full``,
+``slow-disk``) default to rank 0, the only rank that writes checkpoints —
+targeting the last rank would be a silent no-op on multi-host runs.
 
 A fault plan is process-global (``configure(args)``); ``reset()`` clears
 it (tests).  With no ``--fault-inject`` every hook is a cheap no-op.
@@ -66,6 +80,9 @@ KINDS = (
     "geometry-skew",
     "collective-delay",
     "truncate-checkpoint",
+    "bit-flip-checkpoint",
+    "disk-full",
+    "slow-disk",
     "raise",
     "loss-spike",
     "grad-explosion",
@@ -74,6 +91,15 @@ KINDS = (
 # metric-fault kinds perturb REPLICATED jit inputs, so they must fire
 # identically on every rank — @RANK targeting is rejected for them
 _ALL_RANK_KINDS = ("loss-spike", "grad-explosion")
+
+# checkpoint-storage kinds act where checkpoints are WRITTEN, so their
+# rank target defaults to the writer (rank 0), not the last rank
+_CKPT_WRITER_KINDS = (
+    "truncate-checkpoint",
+    "bit-flip-checkpoint",
+    "disk-full",
+    "slow-disk",
+)
 
 _SEED_SKEW_OFFSET = 1000
 _DEFAULT_DELAY_SECONDS = 30.0
@@ -111,10 +137,10 @@ class FaultPlan:
     def rank(self) -> int:
         if self._rank is not None:
             return self._rank
-        if self.kind == "truncate-checkpoint":
+        if self.kind in _CKPT_WRITER_KINDS:
             # checkpoints are written by rank 0 (is_data_parallel_master);
-            # defaulting to the last rank would make this kind a silent
-            # no-op on multi-host runs
+            # defaulting to the last rank would make these kinds silent
+            # no-ops on multi-host runs
             return 0
         import jax
 
@@ -134,7 +160,12 @@ class FaultPlan:
     def __repr__(self):
         if self.kind in _ALL_RANK_KINDS:
             return f"FaultPlan({self.kind}@{self.step}@all-ranks)"
-        rank = self._rank if self._rank is not None else "<last>"
+        if self._rank is not None:
+            rank = self._rank
+        elif self.kind in _CKPT_WRITER_KINDS:
+            rank = "<writer:0>"
+        else:
+            rank = "<last>"
         return f"FaultPlan({self.kind}@{self.step}@rank{rank})"
 
 
@@ -282,6 +313,87 @@ def maybe_truncate_checkpoint(path: str) -> None:
         )
     except OSError as e:  # directory checkpoints (orbax) are not truncatable
         logger.warning(f"chaos: could not truncate {path}: {e}")
+
+
+_DEFAULT_FLIP_BYTES = 1
+_DEFAULT_SLOW_DISK_SECONDS = 5.0
+
+
+def maybe_bit_flip_checkpoint(path: str) -> None:
+    """Flip N payload bytes of a just-written checkpoint — silent bit rot
+    at rest.  Runs AFTER every write-side check (fsync, rename, read-back
+    verification), exactly like real rot: only the VERIFIED LOAD path can
+    catch it.  For v2 files the flips land inside the manifested payload
+    region (flipping the envelope would be caught structurally, which is
+    the boring case); v1 files are flipped mid-file, where array buffers
+    live — the flip that unpickles cleanly into wrong weights."""
+    if (
+        _plan is None
+        or _plan.kind != "bit-flip-checkpoint"
+        or not _plan.active(_last_step)
+    ):
+        return
+    import os
+
+    nbytes = int(_plan.param) if _plan.param is not None else _DEFAULT_FLIP_BYTES
+    try:
+        size = os.path.getsize(path)
+        from unicore_tpu.checkpoint import format as ckpt_format
+
+        bounds = ckpt_format.payload_bounds(path)
+        lo, hi = bounds if bounds is not None else (size // 4, size)
+        span = max(1, hi - lo)
+        with open(path, "r+b") as f:
+            for i in range(nbytes):
+                # deterministic spread across the payload (midpoints of
+                # nbytes equal slices) — reproducible without host RNG
+                off = lo + (span * (2 * i + 1)) // (2 * nbytes)
+                f.seek(off)
+                byte = f.read(1)
+                f.seek(off)
+                f.write(bytes([byte[0] ^ 0x01]))
+        logger.warning(
+            f"chaos: flipped {nbytes} payload byte(s) of checkpoint "
+            f"{path} (silent bit rot at rest; a v1 pickle would resume "
+            "from wrong weights — the v2 manifest must reject it)"
+        )
+    except OSError as e:  # directory checkpoints (orbax) are not flippable
+        logger.warning(f"chaos: could not bit-flip {path}: {e}")
+
+
+def maybe_disk_full(path: str) -> None:
+    """Raise ENOSPC out of the checkpoint write attempt (persistent from
+    STEP onward) — drives the --on-save-failure escalation ladder."""
+    if (
+        _plan is None
+        or _plan.kind != "disk-full"
+        or not _plan.active(_last_step)
+    ):
+        return
+    import errno
+
+    logger.warning(f"chaos: injecting ENOSPC into checkpoint write {path}")
+    raise OSError(errno.ENOSPC, f"chaos: injected disk-full writing {path}")
+
+
+def maybe_slow_disk(path: str) -> None:
+    """Stall the checkpoint write (default 5s) — drives the
+    --preemption-save-deadline over-budget diagnosis."""
+    if (
+        _plan is None
+        or _plan.kind != "slow-disk"
+        or not _plan.active(_last_step)
+    ):
+        return
+    delay = (
+        float(_plan.param)
+        if _plan.param is not None
+        else _DEFAULT_SLOW_DISK_SECONDS
+    )
+    logger.warning(
+        f"chaos: slow disk — delaying checkpoint write {path} by {delay:.1f}s"
+    )
+    time.sleep(delay)
 
 
 def maybe_raise(step: int) -> None:

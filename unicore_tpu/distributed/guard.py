@@ -217,6 +217,7 @@ class ConsistencyGuard:
     # -- fingerprint + check ---------------------------------------------
 
     def fingerprint(self, trainer) -> Dict[str, Any]:
+        from unicore_tpu.checkpoint import durable as ckpt_durable
         from unicore_tpu.distributed import chaos
 
         step = int(trainer.get_num_updates())
@@ -225,6 +226,13 @@ class ConsistencyGuard:
         # fingerprint must describe the run being checked
         sentinel = getattr(trainer, "sentinel", None)
         return {
+            # checkpoint save-failure counter (consecutive, total) — a
+            # NOTE, deliberately NOT in _FIELD_ORDER: only the writer
+            # rank accrues failures, so comparing it across hosts would
+            # false-trip the guard.  It rides here so every watchdog
+            # stall dump and gathered diagnosis shows whether this run's
+            # checkpoints have silently stopped landing.
+            "save_health": ckpt_durable.save_failure_token(),
             "config": self.digest,
             "seed": chaos.maybe_skew_seed(step, self.seed),
             "step": step,

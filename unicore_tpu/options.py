@@ -592,6 +592,51 @@ def add_checkpoint_args(parser):
                        help="pickle: single-file numpy pytree (rank-0 write); "
                             "orbax: per-host SHARDED tensorstore checkpoint "
                             "(no rank-0 gather bottleneck, shardings preserved)")
+    # durable-checkpoint subsystem (unicore_tpu/checkpoint/,
+    # docs/robustness.md "Checkpoint durability")
+    group.add_argument("--checkpoint-write-version", type=int, default=2,
+                       choices=[1, 2],
+                       help="on-disk envelope for native checkpoint writes: "
+                            "2 (default) wraps the pickled state in a header "
+                            "(step, config digest, mesh topology) + chunked "
+                            "CRC32 integrity manifest verified before any "
+                            "load trusts the payload; 1 writes the legacy "
+                            "bare pickle for tools that predate the "
+                            "manifest.  Both versions always READ back")
+    group.add_argument("--verify-checkpoint-writes", action="store_true",
+                       help="re-open and CRC-verify every staged checkpoint "
+                            "write against its integrity manifest before "
+                            "publishing it — catches storage that "
+                            "acknowledges writes it corrupted, at the cost "
+                            "of one extra read pass per save")
+    group.add_argument("--on-save-failure", choices=["warn", "abort"],
+                       default="warn",
+                       help="escalation for a TERMINAL checkpoint-save "
+                            "failure (retries exhausted, ENOSPC, failed "
+                            "read-back verification): 'warn' logs and "
+                            "trains on without a fresh checkpoint; 'abort' "
+                            "raises CheckpointWriteError into the training "
+                            "loop.  Either way the consecutive-failure "
+                            "counter rides the consistency-guard "
+                            "fingerprint (save_health)")
+    group.add_argument("--preemption-save-deadline", type=float, default=0.0,
+                       metavar="SECS",
+                       help="time budget for the SIGTERM/SIGINT graceful-"
+                            "stop checkpoint: when set, preemption writes a "
+                            "MINIMAL fsync'd checkpoint_last straight into "
+                            "--save-dir (no publish copies, no best-score "
+                            "bookkeeping, no retention pruning, no retries, "
+                            "no read-back verification) and warns loudly if "
+                            "even that exceeded the budget (0 keeps the "
+                            "full save path on preemption)")
+    group.add_argument("--emergency-save-on-error", action="store_true",
+                       help="opt-in: on a fatal trainer exception, attempt "
+                            "a minimal emergency save to a SEPARATE "
+                            "checkpoint_emergency.pt before re-raising — "
+                            "never clobbers checkpoint_last and is never "
+                            "auto-resumed (the crashing state may itself "
+                            "be the problem); for post-mortem forensics "
+                            "and manual salvage")
     return group
 
 

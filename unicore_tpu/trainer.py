@@ -163,9 +163,15 @@ class Trainer(object):
         self._cumulative_training_time = None
 
         # robustness subsystem: collective watchdog config, fault-injection
-        # plan, and the cross-host consistency guard (distributed/guard.py)
+        # plan, the cross-host consistency guard (distributed/guard.py),
+        # and the durable-checkpoint write policy (checkpoint/durable.py:
+        # write format version, read-back verification, save-failure
+        # escalation)
         guard.configure(args)
         chaos.configure(args)
+        from unicore_tpu.checkpoint import durable as ckpt_durable
+
+        ckpt_durable.configure(args)
         self.guard = guard.ConsistencyGuard(args)
         # training-health sentinel (unicore_tpu/health/): loss-spike /
         # grad-explosion / scale-collapse detection with in-memory rewind;
@@ -1686,7 +1692,7 @@ class Trainer(object):
         ckptr.save(path, self._orbax_state_to_save())
         ckptr.wait_until_finished()
         if not self.is_data_parallel_master:
-            return
+            return True
         meta = {
             "args": self.args,
             "optimizer_history": [
@@ -1706,7 +1712,13 @@ class Trainer(object):
                 **extra_state,
             },
         }
-        checkpoint_utils.persistent_save(meta, os.path.join(path, "meta.pk"))
+        # a shard directory without its meta.pk is unrestorable — a
+        # terminal meta write failure (warn policy returns False) must
+        # fail the WHOLE save, or the publish step would hand out a
+        # checkpoint that can never load
+        return checkpoint_utils.persistent_save(
+            meta, os.path.join(path, "meta.pk"), meta=self.checkpoint_meta()
+        ) is not False
 
     def _orbax_restore(self, path, reset_optimizer):
         path = os.path.abspath(path)
@@ -1803,16 +1815,48 @@ class Trainer(object):
             state["ema"] = checkpoint_utils.to_numpy_tree(self._state["ema"])
         return state
 
+    def checkpoint_meta(self):
+        """Provenance for the checkpoint v2 header (format version, step,
+        config digest, mesh/suffix topology): lets an operator — and the
+        verified load path — interrogate a multi-GB file without
+        unpickling it."""
+        return {
+            "step": self.get_num_updates(),
+            # the digest the consistency guard compares across hosts —
+            # reusing its cached value (computed once at startup) keeps
+            # the header from ever drifting from what the guard checks
+            "config_digest": self.guard.digest,
+            "suffix": self.checkpoint_suffix,
+            "process_count": jax.process_count(),
+            "mesh": dict(getattr(self.mesh, "shape", None) or {}),
+        }
+
     def save_checkpoint(self, filename, extra_state):
+        """Returns False when the write terminally failed under
+        ``--on-save-failure warn`` (the ``abort`` policy raises instead);
+        callers must not publish or report a checkpoint that never
+        landed."""
         logger.info(f"Saving checkpoint to {filename}")
+        saved = True
         if self._use_orbax() and self._state is not None:
-            self._orbax_save(filename, extra_state)
+            # the shard write raises on failure; the meta.pk write
+            # reports through the save-failure policy (False under warn)
+            saved = self._orbax_save(filename, extra_state) is not False
         else:
             state_dict = self.state_dict()
             state_dict["extra_state"].update(extra_state)
             if self.should_save_checkpoint_on_current_rank:
-                checkpoint_utils.persistent_save(state_dict, filename)
-        logger.info(f"Finished saving checkpoint to {filename}")
+                saved = checkpoint_utils.persistent_save(
+                    state_dict, filename, meta=self.checkpoint_meta()
+                ) is not False
+        if saved:
+            logger.info(f"Finished saving checkpoint to {filename}")
+        else:
+            logger.warning(
+                f"checkpoint write to {filename} did NOT land (see the "
+                "save-failure diagnostics above)"
+            )
+        return saved
 
     def load_checkpoint(
         self,

@@ -191,10 +191,25 @@ class TrainSession:
             stopping = True
 
         if do_save or stopping:
+            # --preemption-save-deadline: a SIGTERM grace budget is short
+            # and non-negotiable, so the preemption save takes the
+            # deadline-bounded MINIMAL path (one fsync'd checkpoint_last,
+            # no publish copies / best bookkeeping / retention / retries)
+            emergency = (
+                "preempt"
+                if preempt_sig
+                and getattr(self.args, "preemption_save_deadline", 0) > 0
+                else None
+            )
             checkpoint_utils.save_checkpoint(
                 self.args, self.trainer, epoch_itr, valid_losses[0],
-                self.copy_pool,
+                self.copy_pool, emergency=emergency,
             )
+            if emergency is not None:
+                # the emergency path drained + closed the pool (its
+                # queued publishes of OLDER checkpoints must not land
+                # after the emergency rename); close() must not re-join
+                self.copy_pool = None
         return valid_losses, stopping
 
     def close(self):
@@ -286,12 +301,37 @@ def main(args) -> None:
                 load_dataset=task.has_sharded_data("train"),
                 disable_iterator_cache=False,
             )
+    except Exception as err:
+        _maybe_emergency_save_on_error(args, trainer, epoch_itr, err)
+        raise
     finally:
         if profiling:
             jax.profiler.stop_trace()
         session.close()
 
     logger.info(f"done training in {time.time() - started:.1f} seconds")
+
+
+def _maybe_emergency_save_on_error(args, trainer, epoch_itr, err) -> None:
+    """--emergency-save-on-error: before a fatal trainer exception unwinds
+    the process, attempt one minimal save to ``checkpoint_emergency.pt``
+    (a separate name — the crashing state may itself be the problem, so
+    it must neither clobber checkpoint_last nor be auto-resumed).  Best
+    effort only: a second failure here must not mask the original one."""
+    if not getattr(args, "emergency_save_on_error", False):
+        return
+    from unicore_tpu import checkpoint_utils
+
+    logger.error(
+        f"fatal trainer exception ({type(err).__name__}: {err}); attempting "
+        "an emergency checkpoint before aborting (--emergency-save-on-error)"
+    )
+    try:
+        checkpoint_utils.save_checkpoint(
+            args, trainer, epoch_itr, None, None, emergency="error"
+        )
+    except Exception:
+        logger.exception("emergency save failed; aborting without it")
 
 
 def restore_session(args, trainer):
