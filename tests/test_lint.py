@@ -977,3 +977,93 @@ def test_sync_transfer_negative_prefetcher_home(tmp_path):
     vs = lint_paths([str(home / "prefetch.py")],
                     rules=build_rules(["sync-transfer-in-step"]))
     assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# unguarded-kv-wait
+# ---------------------------------------------------------------------------
+
+
+def test_unguarded_kv_wait_blocking_get(tmp_path):
+    """A raw blocking_key_value_get outside utils/retry.py blocks the full
+    client timeout on a dead peer, with no shutdown predicate and no
+    kv-outage chaos coverage (positive fixture 1)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        def exchange(client, key):
+            return client.blocking_key_value_get(key, 600000)
+        """,
+        select=["unguarded-kv-wait"],
+    )
+    assert rule_names(vs) == ["unguarded-kv-wait"]
+    assert "blocking_key_value_get" in vs[0].message
+    assert "retry.kv_wait" in vs[0].message
+
+
+def test_unguarded_kv_wait_barrier_and_bytes_variant(tmp_path):
+    """wait_at_barrier and the _bytes get variant are blocking too — both
+    shapes are caught in one module (positive fixture 2)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        def rendezvous(client, tag, payload_key):
+            client.wait_at_barrier(tag, 300000)
+            return client.blocking_key_value_get_bytes(payload_key, 300000)
+        """,
+        select=["unguarded-kv-wait"],
+    )
+    assert sorted(rule_names(vs)) == ["unguarded-kv-wait"] * 2
+    joined = " ".join(v.message for v in vs)
+    assert "wait_at_barrier" in joined
+    assert "blocking_key_value_get_bytes" in joined
+
+
+def test_unguarded_kv_wait_negatives(tmp_path):
+    """Non-blocking KV calls (set/delete/dir_get), the retry.kv_wait
+    consumer idiom, and a '# lint: kv-deadline-bounded' justification all
+    stay un-flagged (negative fixture)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        from unicore_tpu.utils import retry
+
+        def publish(client, key, value):
+            client.key_value_set(key, value, allow_overwrite=True)
+            client.key_value_delete(key)
+            return client.key_value_dir_get(key)
+
+        def wait_through_helper(client, key):
+            return retry.kv_wait(client, key, timeout=60.0)
+
+        def own_deadline(client, key):
+            # this caller carries its own bounded deadline end to end
+            return client.blocking_key_value_get(key, 50)  # lint: kv-deadline-bounded
+        """,
+        select=["unguarded-kv-wait"],
+    )
+    assert vs == []
+
+
+def test_unguarded_kv_wait_home_module_exempt(tmp_path):
+    """utils/retry.py is the sanctioned home (its kv_wait/kv_fetch ARE the
+    deadline wrappers); a lookalike path does not ride the exemption
+    (negative fixture 2)."""
+    home = tmp_path / "utils"
+    home.mkdir()
+    src = (
+        "def kv_wait(client, key, timeout):\n"
+        "    return client.blocking_key_value_get(key, 1000)\n"
+    )
+    (home / "retry.py").write_text(src)
+    assert lint_paths(
+        [str(home / "retry.py")], rules=build_rules(["unguarded-kv-wait"])
+    ) == []
+    lookalike = tmp_path / "myutils"
+    lookalike.mkdir()
+    (lookalike / "notretry.py").write_text(src)
+    vs = lint_paths(
+        [str(lookalike / "notretry.py")],
+        rules=build_rules(["unguarded-kv-wait"]),
+    )
+    assert rule_names(vs) == ["unguarded-kv-wait"]

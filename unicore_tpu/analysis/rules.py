@@ -883,7 +883,69 @@ class UntimedCollective(LintRule):
 
 
 # ---------------------------------------------------------------------------
-# 8. raw-checkpoint-write
+# 8. unguarded-kv-wait
+# ---------------------------------------------------------------------------
+
+# the coordination-service client calls that BLOCK until a peer acts (or
+# a client-side deadline expires); non-blocking reads/writes
+# (key_value_set, key_value_dir_get, key_value_delete) stay un-flagged
+_KV_WAIT_ATTRS = frozenset(
+    {
+        "blocking_key_value_get",
+        "blocking_key_value_get_bytes",
+        "wait_at_barrier",
+    }
+)
+
+# the one module allowed to touch them: utils/retry.py's kv_wait/kv_fetch
+# poll in short deadline-bounded slices, honor shutdown/abort predicates,
+# and simulate the kv-outage chaos kind
+_KV_WAIT_HOME = os.path.join("utils", "retry.py")
+
+
+@register_lint_rule("unguarded-kv-wait")
+class UnguardedKvWait(LintRule):
+    name = "unguarded-kv-wait"
+    justifications = ("kv-deadline-bounded",)
+    description = (
+        "blocking coordination-service KV call (blocking_key_value_get, "
+        "wait_at_barrier) outside unicore_tpu/utils/retry.py's deadline-"
+        "bounded helpers: a dead peer or a dark KV service blocks it for "
+        "the full client timeout (or forever) with no shutdown hook and "
+        "no kv-outage chaos coverage — route through retry.kv_wait/"
+        "kv_fetch, or justify a call that carries its own deadline with "
+        "'# lint: kv-deadline-bounded'"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        norm = os.path.normpath(module.path)
+        # exact path-component match, same precision discipline as the
+        # other home exemptions: 'myutils/retry.py' must NOT ride it
+        if norm == _KV_WAIT_HOME or norm.endswith(os.sep + _KV_WAIT_HOME):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _KV_WAIT_ATTRS
+            ):
+                yield _v(
+                    self,
+                    module,
+                    node,
+                    f"blocking KV call .{func.attr}(...) outside "
+                    "utils/retry.py: it can block the full client timeout "
+                    "(or forever) on a dead peer or a dark coordination "
+                    "service, with no shutdown predicate and no kv-outage "
+                    "chaos coverage — use retry.kv_wait/kv_fetch, or "
+                    "justify with '# lint: kv-deadline-bounded'",
+                )
+
+
+# ---------------------------------------------------------------------------
+# 9. raw-checkpoint-write
 # ---------------------------------------------------------------------------
 
 # the sanctioned checkpoint write path: checkpoint_utils.persistent_save

@@ -45,6 +45,7 @@ from unicore_tpu.checkpoint import (
 )
 from unicore_tpu.checkpoint.durable import CheckpointWriteError  # noqa: F401
 from unicore_tpu.checkpoint.format import CorruptCheckpointError
+from unicore_tpu.utils import retry
 
 logger = logging.getLogger(__name__)
 
@@ -875,7 +876,8 @@ def persistent_save(obj, filename, attempts=3, backoff=0.5, meta=None):
     CRC-verifies the staged file before it is trusted.
 
     Transient filesystem errors (e.g. NFS blips) get retries with
-    exponential backoff (``backoff * 2**attempt`` seconds between tries);
+    exponential backoff (``backoff * 2**attempt`` seconds between tries,
+    via the shared :mod:`unicore_tpu.utils.retry` policy surface);
     ENOSPC skips the retries (a full disk does not blip clear).  A
     TERMINAL failure feeds the save-failure tracker's consecutive-failure
     counter (which rides the consistency-guard fingerprint as
@@ -926,52 +928,61 @@ def persistent_save(obj, filename, attempts=3, backoff=0.5, meta=None):
             raise
         return _terminal_failure(e)
 
-    for attempt in range(attempts):
-        try:
-            chaos.maybe_slow_disk(filename)
-            chaos.maybe_disk_full(filename)
-            if policy.write_version >= 2:
-                _format.write(obj, scratch, meta=meta)
-            else:
-                with open(scratch, "wb") as f:
-                    pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
-                    f.flush()
-                    os.fsync(f.fileno())
-            if (
-                policy.verify_writes
-                and deadline is None
-                and _format.is_v2(scratch)
-            ):
-                # read-back verification of the STAGED file, before the
-                # rename publishes it: catches storage that ACKed bytes
-                # it corrupted while the previous good checkpoint still
-                # lives untouched under the final name (verifying after
-                # the rename would have already destroyed it) and while
-                # the data is still in RAM to rewrite — a verify failure
-                # below retries the whole write.  The page cache is
-                # dropped first so the CRC pass reads the MEDIA, not the
-                # kernel's still-resident copy of what we just wrote.
-                _durable.drop_page_cache(scratch)
-                _format.verify(scratch)
-            os.rename(scratch, filename)
-            _durable.fsync_dir(directory)
-            # chaos at-rest damage LAST — it must slip past every
-            # write-side check, exactly like real bit rot (pairs with the
-            # verified load + resume fallback)
-            chaos.maybe_truncate_checkpoint(filename)
-            chaos.maybe_bit_flip_checkpoint(filename)
-            _durable.tracker().note_success()
-            return True
-        except Exception as e:
-            if attempt == attempts - 1 or _durable.is_enospc(e):
-                return _terminal_failure(e)
-            delay = backoff * (2 ** attempt)
-            logger.warning(
-                f"checkpoint write to {filename} failed (attempt "
-                f"{attempt + 1}/{attempts}); retrying in {delay:.1f}s:\n"
-                + traceback.format_exc(limit=2)
-            )
-            time.sleep(delay)
+    def _write_once():
+        chaos.maybe_slow_disk(filename)
+        chaos.maybe_disk_full(filename)
+        if policy.write_version >= 2:
+            _format.write(obj, scratch, meta=meta)
+        else:
+            with open(scratch, "wb") as f:
+                pickle.dump(obj, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+        if (
+            policy.verify_writes
+            and deadline is None
+            and _format.is_v2(scratch)
+        ):
+            # read-back verification of the STAGED file, before the
+            # rename publishes it: catches storage that ACKed bytes
+            # it corrupted while the previous good checkpoint still
+            # lives untouched under the final name (verifying after
+            # the rename would have already destroyed it) and while
+            # the data is still in RAM to rewrite — a verify failure
+            # below retries the whole write.  The page cache is
+            # dropped first so the CRC pass reads the MEDIA, not the
+            # kernel's still-resident copy of what we just wrote.
+            _durable.drop_page_cache(scratch)
+            _format.verify(scratch)
+        os.rename(scratch, filename)
+        _durable.fsync_dir(directory)
+        # chaos at-rest damage LAST — it must slip past every
+        # write-side check, exactly like real bit rot (pairs with the
+        # verified load + resume fallback)
+        chaos.maybe_truncate_checkpoint(filename)
+        chaos.maybe_bit_flip_checkpoint(filename)
+
+    def _warn_retry(err, attempt, delay):
+        # on_retry runs inside retry_call's except block, so format_exc
+        # sees the current exception (and stays Python 3.9 compatible —
+        # single-argument format_exception is 3.10+)
+        logger.warning(
+            f"checkpoint write to {filename} failed (attempt "
+            f"{attempt + 1}/{attempts}); retrying in {delay:.1f}s:\n"
+            + traceback.format_exc(limit=2)
+        )
+
+    try:
+        retry.retry_call(
+            _write_once,
+            retry.RetryPolicy(attempts=attempts, backoff=backoff),
+            giveup=_durable.is_enospc,  # a full disk does not blip clear
+            on_retry=_warn_retry,
+        )
+    except Exception as e:
+        return _terminal_failure(e)
+    _durable.tracker().note_success()
+    return True
 
 
 def verify_checkpoint_directory(save_dir: str) -> None:

@@ -153,12 +153,9 @@ def kv_client():
     TCP side channel lets the producer thread exchange slot plans without
     issuing device collectives (which must stay in training-thread
     program order)."""
-    try:
-        from jax._src import distributed
+    from unicore_tpu.utils import retry
 
-        return distributed.global_state.client
-    except Exception:
-        return None
+    return retry.coordination_client()
 
 
 def _encode(payload) -> str:
@@ -414,36 +411,32 @@ class DevicePrefetcher:
     def _kv_key(self, seq: int, rank: int) -> str:
         return f"unicore_tpu/prefetch_plan/{self._epoch}/{seq}/{rank}"
 
+    def _abort_if_closing(self) -> None:
+        if self._stop.is_set():
+            raise _ProducerStopped()
+
     def _blocking_get(self, key: str) -> str:
-        """``blocking_key_value_get`` in short slices so the producer can
-        observe ``close()`` within ``_KV_POLL_S`` instead of blocking out
-        the whole plan timeout inside the client."""
-        deadline = time.monotonic() + self._plan_timeout
-        while True:
-            if self._stop.is_set():
-                raise _ProducerStopped()
-            if self._queue.full():
-                # our own consumer is paused (mid-epoch validation, a
-                # checkpoint write, a long compile) — peers pause with it,
-                # so hold the deadline instead of charging a global pause
-                # against the peer budget.  A genuinely dead peer still
-                # times out: the consumer drains the queue within `depth`
-                # updates and the clock starts for real.
-                deadline = time.monotonic() + self._plan_timeout
-            left = deadline - time.monotonic()
-            if left <= 0:
-                raise TimeoutError(
-                    f"no value for {key} after {self._plan_timeout:.0f}s"
-                )
-            try:
-                return self._client.blocking_key_value_get(
-                    key, max(1, int(min(self._KV_POLL_S, left) * 1000))
-                )
-            except Exception as e:  # retry only the slice expiring
-                msg = str(e).lower()
-                if "deadline" in msg or "timed out" in msg:
-                    continue
-                raise
+        """Deadline-bounded KV wait through the shared retry surface
+        (utils/retry.py — the ``unguarded-kv-wait`` lint rule pins all
+        blocking KV gets there).  Polled in short slices so the producer
+        observes ``close()`` within ``_KV_POLL_S`` instead of blocking
+        out the whole plan timeout inside the client; while our own queue
+        is full the deadline is HELD — the consumer is paused (mid-epoch
+        validation, a checkpoint write, a long compile), peers pause with
+        it, and a global pause must not be charged against the peer
+        budget.  A genuinely dead peer still times out: the consumer
+        drains the queue within ``depth`` updates and the clock starts
+        for real."""
+        from unicore_tpu.utils import retry
+
+        return retry.kv_wait(
+            self._client,
+            key,
+            timeout=self._plan_timeout,
+            poll_s=self._KV_POLL_S,
+            should_abort=self._abort_if_closing,
+            hold_deadline=self._queue.full,
+        )
 
     def _cleanup_previous_epoch(self):
         """Delete the PREVIOUS epoch's plan-key directory once — called

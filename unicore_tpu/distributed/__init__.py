@@ -1,1 +1,1 @@
-from . import chaos, guard, utils  # noqa
+from . import chaos, elastic, guard, utils  # noqa

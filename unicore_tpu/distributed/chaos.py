@@ -53,6 +53,28 @@ Kinds (all persistent from STEP onward unless noted):
     Same mechanics, but only the gradients are scaled (default 100) —
     the reported loss stays healthy, proving the grad-norm detector
     fires independently of the loss band.
+``host-loss@STEP[@RANK]``
+    The targeted rank hard-exits (``os._exit``, no cleanup, no
+    checkpoint — the process-level equivalent of a machine dying) once
+    the step counter reaches STEP.  Survivors must detect the silent
+    peer within ``--heartbeat-timeout``, record a named-rank verdict,
+    and — under ``--elastic`` — restart from the last verified
+    checkpoint with the re-formed membership.
+``heartbeat-stall[:SECS]@STEP[@RANK]``
+    The targeted rank's heartbeat publisher goes silent for SECS
+    (default 3600 — effectively forever) from STEP onward while the
+    process stays alive: the zombie-host case.  Proves lease-expiry
+    detection fires independently of process death.
+``kv-outage[:SECS]@STEP``
+    The coordination-service KV store is unreachable for SECS (default
+    30) from STEP onward, on EVERY rank (the outage is a property of the
+    service, not a host).  Proves every KV wait is deadline-bounded
+    through ``utils/retry.py`` — bounded blocking, never a hang.
+
+The three elastic kinds above arm only on the FIRST incarnation of an
+elastic run (membership epoch 0, restart count 0): a restarted child
+re-parses the same ``--fault-inject`` argv, and refiring would make the
+run unhealable — the kill replays forever.
 
 For the rank-targetable kinds, RANK defaults to the LAST process (rank
 ``process_count - 1``): on a 2-host cluster the fault lands on rank 1
@@ -86,11 +108,25 @@ KINDS = (
     "raise",
     "loss-spike",
     "grad-explosion",
+    "host-loss",
+    "heartbeat-stall",
+    "kv-outage",
 )
 
 # metric-fault kinds perturb REPLICATED jit inputs, so they must fire
 # identically on every rank — @RANK targeting is rejected for them
 _ALL_RANK_KINDS = ("loss-spike", "grad-explosion")
+
+# service-level kinds model an outage of shared infrastructure, so they
+# fire on every rank too (@RANK rejected), but stay ACTIVE for a wall-
+# clock window instead of being consumed after one step
+_SERVICE_KINDS = ("kv-outage",)
+
+# elastic kinds arm only on the first incarnation of an elastic run: a
+# restarted child re-parses the same --fault-inject argv, and refiring
+# (e.g. host-loss at a step the replay passes again) would make the run
+# unhealable by construction
+_ELASTIC_KINDS = ("host-loss", "heartbeat-stall", "kv-outage")
 
 # checkpoint-storage kinds act where checkpoints are WRITTEN, so their
 # rank target defaults to the writer (rank 0), not the last rank
@@ -125,6 +161,12 @@ class FaultPlan:
                 "replicated jit inputs — a per-rank value would desync the "
                 "hosts); drop the @RANK part"
             )
+        if kind in _SERVICE_KINDS and rank is not None:
+            raise ValueError(
+                f"'{kind}' models an outage of the shared coordination "
+                "service, which every rank experiences at once; drop the "
+                "@RANK part"
+            )
         self.kind = kind
         self.step = step
         self._rank = rank  # None = resolve to last rank at trigger time
@@ -147,7 +189,7 @@ class FaultPlan:
         return jax.process_count() - 1
 
     def on_this_rank(self) -> bool:
-        if self.kind in _ALL_RANK_KINDS:
+        if self.kind in _ALL_RANK_KINDS or self.kind in _SERVICE_KINDS:
             return True
         import jax
 
@@ -158,7 +200,7 @@ class FaultPlan:
         return step >= self.step and self.on_this_rank()
 
     def __repr__(self):
-        if self.kind in _ALL_RANK_KINDS:
+        if self.kind in _ALL_RANK_KINDS or self.kind in _SERVICE_KINDS:
             return f"FaultPlan({self.kind}@{self.step}@all-ranks)"
         if self._rank is not None:
             rank = self._rank
@@ -188,6 +230,21 @@ def parse_fault_spec(spec: str) -> FaultPlan:
 
 _plan: Optional[FaultPlan] = None
 _last_step: int = 0
+# wall clock of the first step at/after a windowed (service/heartbeat)
+# fault's trigger — the [:SECS] window is measured from here
+_window_started: Optional[float] = None
+
+
+def _elastic_incarnation() -> int:
+    """How many elastic re-formations/restarts this process is past.  Read
+    straight from the supervisor env (see distributed/elastic.py for the
+    variable contract) rather than importing elastic — chaos must stay
+    import-light and cycle-free."""
+    import os
+
+    return int(os.environ.get("UNICORE_TPU_MEMBERSHIP_EPOCH", "0") or 0) + int(
+        os.environ.get("UNICORE_TPU_ELASTIC_RESTARTS", "0") or 0
+    )
 
 
 def configure(args) -> Optional[FaultPlan]:
@@ -195,20 +252,33 @@ def configure(args) -> Optional[FaultPlan]:
     DISARM a stale one when the flag is unset, so an in-process sweep
     driver (``--suppress-crashes``) cannot leak trial 1's fault into
     trial 2."""
-    global _plan
+    global _plan, _window_started
     spec = getattr(args, "fault_inject", None)
     if not spec:
         _plan = None
         return None
-    _plan = parse_fault_spec(spec)
+    plan = parse_fault_spec(spec)
+    if plan.kind in _ELASTIC_KINDS and _elastic_incarnation() > 0:
+        # a restarted elastic child re-parses the same argv; refiring the
+        # kill/stall/outage would make the run unhealable by construction
+        logger.warning(
+            f"chaos: '{plan.kind}' DISARMED on restarted incarnation "
+            f"{_elastic_incarnation()} (elastic kinds fire on the first "
+            "incarnation only)"
+        )
+        _plan = None
+        return None
+    _plan = plan
+    _window_started = None
     logger.warning(f"fault injection ARMED: {_plan} (this is a chaos run)")
     return _plan
 
 
 def reset() -> None:
-    global _plan, _last_step
+    global _plan, _last_step, _window_started
     _plan = None
     _last_step = 0
+    _window_started = None
 
 
 def note_step(step: int) -> None:
@@ -225,6 +295,7 @@ def note_step(step: int) -> None:
         and step > _plan.step
     ):
         _plan.consumed = True
+    maybe_host_loss(step)
 
 
 def maybe_skew_seed(step: int, seed: int) -> int:
@@ -394,6 +465,66 @@ def maybe_slow_disk(path: str) -> None:
         f"chaos: slow disk — delaying checkpoint write {path} by {delay:.1f}s"
     )
     time.sleep(delay)
+
+
+_DEFAULT_HEARTBEAT_STALL_SECONDS = 3600.0
+_DEFAULT_KV_OUTAGE_SECONDS = 30.0
+
+#: the hard-exit status of a chaos ``host-loss`` kill.  Mirrors
+#: elastic.EXIT_WORKER_KILLED (a module-level import either way would be
+#: a cycle: elastic consults chaos from its heartbeat publisher).  The
+#: elastic test suite asserts the two stay equal.
+HOST_LOSS_EXIT_CODE = 74
+
+
+def maybe_host_loss(step: int) -> None:
+    """``host-loss``: hard-exit the targeted rank — ``os._exit``, no
+    atexit hooks, no checkpoint, no goodbye on the KV store.  The closest
+    a test can get to a machine dying: survivors learn about it only from
+    the silence."""
+    if _plan is None or _plan.kind != "host-loss" or not _plan.active(step):
+        return
+    import os
+
+    logger.warning(
+        f"chaos: HOST LOSS — rank {_plan.rank} hard-exiting at step {step} "
+        "(no checkpoint, no cleanup; survivors must detect the silence)"
+    )
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(HOST_LOSS_EXIT_CODE)
+
+
+def _windowed_active(kind: str, default_secs: float) -> bool:
+    """True while a wall-clock-windowed fault is live: from the first step
+    at/after STEP, for [:SECS] (default ``default_secs``) seconds."""
+    global _window_started
+    if _plan is None or _plan.kind != kind or not _plan.active(_last_step):
+        return False
+    if _window_started is None:
+        _window_started = time.monotonic()
+        logger.warning(
+            f"chaos: {kind} window OPEN at step {_last_step} "
+            f"(for {(_plan.param if _plan.param is not None else default_secs):g}s)"
+        )
+    window = _plan.param if _plan.param is not None else default_secs
+    return time.monotonic() - _window_started < float(window)
+
+
+def heartbeat_stalled() -> bool:
+    """``heartbeat-stall``: the targeted rank's publisher must skip its
+    beats while this is True — the process is alive, the lease goes
+    stale, and the peers' monitors must still name it."""
+    return _windowed_active("heartbeat-stall", _DEFAULT_HEARTBEAT_STALL_SECONDS)
+
+
+def kv_outage_active() -> bool:
+    """``kv-outage``: the coordination-service KV store is dark.  Honored
+    inside utils/retry.py's KV helpers, so every consumer experiences the
+    outage — and must stay deadline-bounded through it."""
+    return _windowed_active("kv-outage", _DEFAULT_KV_OUTAGE_SECONDS)
 
 
 def maybe_raise(step: int) -> None:

@@ -37,6 +37,7 @@ import logging
 import signal
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -77,6 +78,7 @@ def reset() -> None:
     """Clear process-global state (tests)."""
     global _collective_timeout, _last_step, _last_fingerprint
     global _worker, _requests, _poisoned, _agreed_stop_signal
+    global _collective_abort_check
     _collective_timeout = 0.0
     _last_step = 0
     _last_fingerprint = None
@@ -84,6 +86,7 @@ def reset() -> None:
     _requests = None
     _poisoned = None
     _agreed_stop_signal = None
+    _collective_abort_check = None
     _clear_stop()
 
 
@@ -93,6 +96,12 @@ def note_step(step: int) -> None:
     from unicore_tpu.distributed import chaos
 
     chaos.note_step(step)
+
+
+def last_step() -> int:
+    """The last update count noted by the trainer — what heartbeat leases
+    publish as training progress."""
+    return _last_step
 
 
 # ---------------------------------------------------------------------------
@@ -120,6 +129,15 @@ _PER_HOST_ARGS = frozenset(
         # host-local compile-cache location (the cached programs are
         # content-addressed; the path itself cannot change the SPMD math)
         "jax_compilation_cache_dir",
+        # per-host supervision policy (distributed/elastic.py): whether a
+        # supervisor wraps THIS host and how eagerly it restarts cannot
+        # change the SPMD math, and mixed deployments (one host under a
+        # restart-less supervisor) are legitimate.  The heartbeat
+        # interval/timeout stay IN the digest — divergent detection
+        # deadlines across hosts produce asymmetric verdicts.
+        "elastic",
+        "max_restarts",
+        "restart_backoff",
     }
 )
 
@@ -178,6 +196,7 @@ def _short_hash(obj) -> Optional[str]:
 # the most downstream symptom of all)
 _FIELD_ORDER = (
     "config",
+    "membership",
     "seed",
     "step",
     "lr",
@@ -218,7 +237,7 @@ class ConsistencyGuard:
 
     def fingerprint(self, trainer) -> Dict[str, Any]:
         from unicore_tpu.checkpoint import durable as ckpt_durable
-        from unicore_tpu.distributed import chaos
+        from unicore_tpu.distributed import chaos, elastic
 
         step = int(trainer.get_num_updates())
         # THIS trainer's sentinel, not a process-global lookup: an
@@ -234,6 +253,11 @@ class ConsistencyGuard:
             # checkpoints have silently stopped landing.
             "save_health": ckpt_durable.save_failure_token(),
             "config": self.digest,
+            # elastic membership epoch (increments at every re-formation):
+            # a stale host relaunched with an old incarnation's environment
+            # is named at the FIRST check — it can never silently rejoin a
+            # newer incarnation of the run
+            "membership": elastic.membership_epoch(),
             "seed": chaos.maybe_skew_seed(step, self.seed),
             "step": step,
             "lr": float(trainer.get_lr()),
@@ -381,6 +405,25 @@ _worker: Optional[threading.Thread] = None
 _requests = None  # queue.Queue created with the worker
 _poisoned: Optional[str] = None
 
+# Early-abort hook for in-flight collectives: the elastic heartbeat
+# monitor installs a callable returning an exception once a peer's lease
+# has expired.  The watchdog's wait loop polls it between short slices,
+# so a collective stalled on a DEAD peer aborts within the heartbeat
+# timeout (with the named-rank verdict) instead of burning the full
+# --collective-timeout with no diagnosis beyond "stalled".
+_collective_abort_check: Optional[Any] = None
+
+#: slice width of the watchdog's wait loop — bounds how stale the abort
+#: check can be, costs one Event.wait wakeup per slice
+_WATCHDOG_POLL_S = 0.5
+
+
+def set_collective_abort_check(check) -> None:
+    """Install (or clear, with None) the early-abort predicate: a callable
+    returning None (keep waiting) or an exception to raise instead."""
+    global _collective_abort_check
+    _collective_abort_check = check
+
 
 def _worker_loop(requests) -> None:
     me = threading.current_thread()
@@ -431,7 +474,9 @@ def run_collective(name: str, fn):
     from unicore_tpu.distributed import chaos
 
     timeout = _collective_timeout
-    if timeout <= 0:
+    if timeout <= 0 and _collective_abort_check is None:
+        # no watchdog AND no elastic abort hook: nothing to poll for, so
+        # skip the worker-thread indirection entirely
         chaos.maybe_delay_collective(name)
         return fn()
     if _poisoned is not None:
@@ -450,18 +495,52 @@ def run_collective(name: str, fn):
     box: Dict[str, Any] = {}
     done = threading.Event()
     requests.put((name, work, box, done))
-    if not done.wait(timeout):
-        stacks = format_thread_stacks()
-        msg = (
-            f"collective '{name}' stalled for more than {timeout:.1f}s "
-            f"(--collective-timeout).  Last known step: {_last_step}; last "
-            f"fingerprint: {_last_fingerprint}.  A peer host has likely "
-            "desynced, crashed, or been preempted; raising instead of "
-            "hanging forever."
+    # sliced wait: between slices the elastic abort hook gets a look, so a
+    # collective stalled on a peer the heartbeat monitor has already
+    # declared dead aborts within the heartbeat timeout, not the (much
+    # longer) collective timeout
+    # timeout <= 0 here means "watchdog disabled but the elastic abort
+    # hook is installed": wait forever EXCEPT for verdicts — a collective
+    # wedged on a dead peer must still abort within the heartbeat timeout
+    deadline = time.monotonic() + timeout if timeout > 0 else None
+    finished = False
+    abort_exc: Optional[BaseException] = None
+    while True:
+        left = (
+            deadline - time.monotonic()
+            if deadline is not None
+            else _WATCHDOG_POLL_S
         )
+        if left <= 0:
+            break
+        if done.wait(min(_WATCHDOG_POLL_S, left)):
+            finished = True
+            break
+        if _collective_abort_check is not None:
+            abort_exc = _collective_abort_check()
+            if abort_exc is not None:
+                break
+    if not finished:
+        stacks = format_thread_stacks()
+        if abort_exc is not None:
+            msg = (
+                f"collective '{name}' abandoned at step {_last_step}: "
+                f"{abort_exc} (the worker thread may still be blocked "
+                "inside the collective; the plane is poisoned)"
+            )
+        else:
+            msg = (
+                f"collective '{name}' stalled for more than {timeout:.1f}s "
+                f"(--collective-timeout).  Last known step: {_last_step}; "
+                f"last fingerprint: {_last_fingerprint}.  A peer host has "
+                "likely desynced, crashed, or been preempted; raising "
+                "instead of hanging forever."
+            )
         _poisoned = f"'{name}' at step {_last_step}"
         _worker = None  # the old worker is lost inside the stalled call
         logger.error(msg + "\nPython thread stacks at stall:\n" + stacks)
+        if abort_exc is not None:
+            raise abort_exc
         raise CollectiveTimeoutError(msg)
     if "error" in box:
         raise box["error"]
@@ -514,6 +593,22 @@ def install_signal_handlers() -> bool:
             "thread); preemption will not checkpoint"
         )
         return False
+
+
+def request_stop(reason: str) -> None:
+    """Programmatic graceful-stop request — same machinery as a SIGTERM,
+    but initiated by a subsystem (the elastic heartbeat monitor asking
+    every survivor to stop on an agreed update for restart).  The reason
+    string rides the per-update slot-plan gather exactly like a signal
+    name, so all hosts stop on the same update."""
+    global _stop_signal
+    _stop_signal = reason
+    _stop_event.set()
+    logger.warning(
+        f"graceful stop requested ({reason}): will finish the in-flight "
+        "update, stop at the collectively agreed update, and save a "
+        "checkpoint"
+    )
 
 
 def stop_requested() -> Optional[str]:

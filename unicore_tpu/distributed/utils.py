@@ -87,10 +87,22 @@ def distributed_init(args) -> int:
                 )
             except Exception:
                 pass  # older/newer jax without the option: keep defaults
+        init_kwargs = {}
+        try:
+            # elastic restarts bound the rendezvous: a re-formed membership
+            # that cannot assemble (a peer really is gone) must fail fast
+            # and return control to the supervisor, not burn 300s per
+            # attempt (distributed/elastic.py sets this for its children)
+            rdv = int(os.environ.get("UNICORE_TPU_RENDEZVOUS_TIMEOUT", "0"))
+            if rdv > 0:
+                init_kwargs["initialization_timeout"] = rdv
+        except ValueError:
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
+            **init_kwargs,
         )
         _initialized = True
     args.distributed_rank = jax.process_index()

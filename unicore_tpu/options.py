@@ -438,7 +438,52 @@ def add_distributed_training_args(parser, default_world_size=None):
                             "loss-spike[:MAGNITUDE] and "
                             "grad-explosion[:SCALE] fire on EVERY rank at "
                             "exactly STEP (once) to prove the training-"
-                            "health sentinel detects, rewinds, and heals")
+                            "health sentinel detects, rewinds, and heals; "
+                            "host-loss (hard process exit), "
+                            "heartbeat-stall[:SECS] (alive but silent), and "
+                            "kv-outage[:SECS] (coordination service dark, "
+                            "every rank) prove the elastic control plane "
+                            "detects, bounds, and restarts")
+    # elastic run control plane (distributed/elastic.py,
+    # docs/robustness.md "Elastic runs")
+    group.add_argument("--elastic", action="store_true",
+                       help="supervised elastic run: the CLI becomes a "
+                            "per-host supervisor that runs training as a "
+                            "child process, arms the heartbeat host-loss "
+                            "monitor, and restarts RETRYABLE failures "
+                            "(host loss, collective timeout, data stall, "
+                            "control-plane outage, a signal-killed child) "
+                            "from the last verified checkpoint with a "
+                            "re-formed membership; fatal failures "
+                            "(divergence, corrupt checkpoint with no "
+                            "fallback, sentinel abort) propagate "
+                            "immediately (see the exit-code table in "
+                            "docs/robustness.md)")
+    group.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                       help="restart budget of the --elastic supervisor; "
+                            "once spent, the next retryable failure "
+                            "propagates with its taxonomy exit code")
+    group.add_argument("--restart-backoff", type=float, default=1.0,
+                       metavar="SECS",
+                       help="base delay of the --elastic restart backoff "
+                            "(exponential, jittered, capped at 60s): "
+                            "restart k waits ~SECS * 2^(k-1)")
+    group.add_argument("--heartbeat-interval", type=float, default=10.0,
+                       metavar="SECS",
+                       help="multi-host liveness lease cadence: every host "
+                            "publishes a heartbeat (membership epoch, beat "
+                            "seq, trained step) to the coordination-service "
+                            "KV store this often — one tiny KV set per "
+                            "interval, always on for multi-host runs "
+                            "(0 disables publishing)")
+    group.add_argument("--heartbeat-timeout", type=float, default=60.0,
+                       metavar="SECS",
+                       help="host-loss deadline (--elastic only): a peer "
+                            "whose lease stops advancing for this long gets "
+                            "a named-rank verdict recorded in the KV store, "
+                            "all survivors stop on an agreed update, and "
+                            "the supervisor re-forms the run without it "
+                            "(0 disables the monitor)")
     return group
 
 

@@ -46,7 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from unicore_tpu import checkpoint_utils, health, utils
-from unicore_tpu.distributed import chaos, guard
+from unicore_tpu.distributed import chaos, elastic, guard
 from unicore_tpu.distributed import utils as distributed_utils
 from unicore_tpu.ema import ema_to_model_dtype, init_ema, update_ema
 from unicore_tpu.logging import meters, metrics
@@ -1809,6 +1809,10 @@ class Trainer(object):
                 "sentinel": self.sentinel.state_dict()
                 if self.sentinel is not None
                 else None,
+                # elastic incarnation that wrote this state: a stale host
+                # relaunched with an old epoch environment refuses a
+                # checkpoint written by a newer incarnation at load
+                "membership_epoch": elastic.membership_epoch(),
             },
         }
         if self.use_ema and self._state is not None and "ema" in self._state:
@@ -1829,6 +1833,8 @@ class Trainer(object):
             "suffix": self.checkpoint_suffix,
             "process_count": jax.process_count(),
             "mesh": dict(getattr(self.mesh, "shape", None) or {}),
+            # which elastic incarnation wrote the file (0 = never re-formed)
+            "membership_epoch": elastic.membership_epoch(),
         }
 
     def save_checkpoint(self, filename, extra_state):
@@ -1884,6 +1890,12 @@ class Trainer(object):
                 )
             extra_state = state.get("extra_state", None)
             last_optim_state = state.get("optimizer_state", None)
+            # elastic runs only: a checkpoint written by a NEWER membership
+            # epoch proves THIS host is a stale incarnation rejoining — a
+            # named, fatal refusal beats silently rewinding the cluster
+            elastic.check_checkpoint_epoch(
+                (extra_state or {}).get("membership_epoch")
+            )
 
             # model params: need a state; if missing, defer until first batch
             if self._state is None:

@@ -1,0 +1,219 @@
+"""One audited retry/deadline surface for every blocking control-plane wait.
+
+Before this module, three subsystems each hand-rolled their own policy:
+``persistent_save`` had an inline ``backoff * 2**attempt`` loop, the
+device prefetcher polled the coordination-service KV store in ad-hoc 2s
+slices, and the elastic control plane was about to grow a third copy.
+Divergent retry policies are a reliability bug factory — one caller
+forgets the deadline, another retries ENOSPC forever, a third blocks a
+shutdown path behind a peer's full timeout.  Everything lives here now:
+
+* :class:`RetryPolicy` / :func:`retry_call` — bounded attempts with
+  exponential backoff, optional jitter (de-synchronizes a fleet of hosts
+  retrying the same shared resource), and an optional overall deadline;
+* :func:`kv_wait` — a deadline-bounded blocking KV get that polls in
+  short slices so the caller can observe shutdown requests and
+  queue-pressure pauses instead of blocking out the whole timeout inside
+  the client;
+* :func:`kv_fetch` — a non-blocking-ish KV probe that classifies the
+  outcome (value / :data:`ABSENT` / :data:`UNREACHABLE`) instead of
+  raising an open set of client exceptions at every caller.
+
+The ``kv-outage`` chaos kind (``distributed/chaos.py``) is honored INSIDE
+the KV helpers, so every consumer — prefetch plan exchange, heartbeat
+monitor, elastic verdicts — provably stays bounded when the coordination
+service goes dark: the ``unguarded-kv-wait`` lint rule pins all blocking
+KV calls to this module.
+"""
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class KVTimeoutError(TimeoutError):
+    """A deadline-bounded KV wait expired (the peer never published, or
+    the coordination service stayed unreachable past the budget)."""
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff + jitter + deadline, shared by checkpointing,
+    the prefetch plan exchange, and the elastic restart supervisor."""
+
+    #: total tries (the first call counts as attempt 0)
+    attempts: int = 3
+    #: base delay in seconds before the first retry
+    backoff: float = 0.5
+    #: per-retry growth factor
+    multiplier: float = 2.0
+    #: fraction of each delay randomized UP (0.25 -> delay * [1, 1.25));
+    #: jitter spreads a fleet of hosts retrying the same shared resource
+    jitter: float = 0.0
+    #: cap on any single delay (None = uncapped)
+    max_delay: Optional[float] = None
+    #: overall wall budget in seconds (None = bounded by attempts alone)
+    deadline: Optional[float] = None
+
+
+def compute_delay(policy: RetryPolicy, attempt: int,
+                  rng: Callable[[], float] = random.random) -> float:
+    """Delay before retry number ``attempt + 1`` (0-based attempts)."""
+    delay = policy.backoff * (policy.multiplier ** attempt)
+    if policy.max_delay is not None:
+        delay = min(delay, policy.max_delay)
+    if policy.jitter > 0:
+        delay *= 1.0 + policy.jitter * rng()
+    return delay
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    policy: RetryPolicy,
+    *,
+    giveup: Optional[Callable[[BaseException], bool]] = None,
+    on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+    rng: Callable[[], float] = random.random,
+    clock: Optional[Callable[[], float]] = None,
+):
+    """Run ``fn`` under ``policy``; returns its result or re-raises its
+    LAST error once attempts (or the deadline) are exhausted.
+
+    ``giveup(err)`` short-circuits retries for errors that cannot blip
+    clear (a full disk, a refused credential).  ``on_retry(err, attempt,
+    delay)`` runs before each sleep — callers own their log wording.
+    ``sleep``/``clock`` default to the ``time`` module's, resolved at
+    CALL time so tests patching ``time.sleep`` see the retries."""
+    sleep = time.sleep if sleep is None else sleep
+    clock = time.monotonic if clock is None else clock
+    deadline = None if policy.deadline is None else clock() + policy.deadline
+    attempts = max(1, int(policy.attempts))
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as err:
+            if attempt == attempts - 1:
+                raise
+            if giveup is not None and giveup(err):
+                raise
+            delay = compute_delay(policy, attempt, rng)
+            if deadline is not None and clock() + delay > deadline:
+                raise
+            if on_retry is not None:
+                on_retry(err, attempt, delay)
+            sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# coordination-service KV helpers
+# ---------------------------------------------------------------------------
+
+#: the key holds no value yet (or the service answered "not found")
+ABSENT = object()
+#: the service did not answer (connection failure, injected kv-outage)
+UNREACHABLE = object()
+
+#: default poll slice: short enough that shutdown/abort predicates are
+#: observed promptly, long enough that the KV server isn't hammered
+DEFAULT_KV_POLL_S = 2.0
+
+
+def coordination_client():
+    """The distributed coordination service's KV store client, or None
+    when this process isn't part of a ``jax.distributed`` cluster.  The
+    TCP side channel lets producer/monitor threads exchange control-plane
+    state without issuing device collectives (which must stay in
+    training-thread program order)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+def _kv_outage_active() -> bool:
+    from unicore_tpu.distributed import chaos
+
+    return chaos.kv_outage_active()
+
+
+def _looks_like_kv_timeout(err: BaseException) -> bool:
+    msg = str(err).lower()
+    return "deadline" in msg or "timed out" in msg or "timeout" in msg
+
+
+def kv_wait(
+    client,
+    key: str,
+    timeout: float,
+    *,
+    poll_s: float = DEFAULT_KV_POLL_S,
+    should_abort: Optional[Callable[[], None]] = None,
+    hold_deadline: Optional[Callable[[], bool]] = None,
+    describe: str = "",
+    clock: Optional[Callable[[], float]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+) -> str:
+    """Deadline-bounded ``blocking_key_value_get`` in ``poll_s`` slices.
+
+    Polling in slices (instead of handing the client the whole timeout)
+    is what keeps every consumer responsive: ``should_abort`` is invoked
+    between slices and may raise to abandon the wait (a prefetcher
+    observing ``close()``), and while ``hold_deadline()`` returns True
+    the budget is re-armed (our own consumer is paused — a global
+    validation/checkpoint pause must not be charged against the peer).
+    An injected ``kv-outage`` burns slices without touching the client,
+    so an outage longer than ``timeout`` surfaces as
+    :class:`KVTimeoutError` — never an unbounded block."""
+    clock = time.monotonic if clock is None else clock
+    sleep = time.sleep if sleep is None else sleep
+    deadline = clock() + timeout
+    while True:
+        if should_abort is not None:
+            should_abort()
+        if hold_deadline is not None and hold_deadline():
+            deadline = clock() + timeout
+        left = deadline - clock()
+        if left <= 0:
+            raise KVTimeoutError(
+                f"no value for {key} after {timeout:.0f}s"
+                + (f" ({describe})" if describe else "")
+            )
+        if _kv_outage_active():
+            # the service is dark: burn one slice against the deadline
+            # instead of handing the client a call that may misbehave
+            sleep(min(poll_s, left))
+            continue
+        try:
+            return client.blocking_key_value_get(
+                key, max(1, int(min(poll_s, left) * 1000))
+            )
+        except Exception as err:  # retry only the slice expiring
+            if _looks_like_kv_timeout(err):
+                continue
+            raise
+
+
+def kv_fetch(client, key: str, *, poll_ms: int = 100):
+    """One bounded KV probe, classified instead of raised.
+
+    Returns the string value, :data:`ABSENT` when the key holds nothing
+    yet (the client reports this as its own deadline expiring), or
+    :data:`UNREACHABLE` when the service did not answer at all (real
+    connection failure or injected ``kv-outage``).  Heartbeat monitors
+    key on the distinction: silence from a PEER is evidence, silence from
+    the SERVICE is not."""
+    if _kv_outage_active():
+        return UNREACHABLE
+    try:
+        return client.blocking_key_value_get(key, max(1, int(poll_ms)))
+    except Exception as err:
+        if _looks_like_kv_timeout(err):
+            return ABSENT
+        return UNREACHABLE

@@ -94,6 +94,17 @@ class TrainSession:
 
             preempt_sig = guard.stop_requested()  # local-only convenience
         if preempt_sig:
+            sig = str(preempt_sig)
+            if sig.startswith(("HOST-LOSS", "CONTROL-PLANE", "SELF-STALE")):
+                # elastic verdict: every survivor stops HERE (the reason
+                # rode the agreed slot-plan gather), saves a checkpoint,
+                # and exits with the retryable taxonomy code so the
+                # supervisor re-forms the run — not exit 0
+                return (
+                    f"elastic verdict {sig}: stopping all survivors at "
+                    "this agreed update; saving a checkpoint, then exiting "
+                    "for a supervised restart"
+                )
             return (
                 f"received {preempt_sig}: graceful stop — the in-flight "
                 "update finished; saving a checkpoint and exiting 0"
@@ -220,7 +231,7 @@ class TrainSession:
 
 def main(args) -> None:
     from unicore_tpu import checkpoint_utils, tasks, utils
-    from unicore_tpu.distributed import guard
+    from unicore_tpu.distributed import elastic, guard
     from unicore_tpu.distributed import utils as distributed_utils
     from unicore_tpu.logging import metrics
     from unicore_tpu.trainer import Trainer
@@ -272,6 +283,11 @@ def main(args) -> None:
         f"{jax.process_count()} hosts"
     )
 
+    # elastic control plane: publish this host's liveness lease (always on
+    # for multi-host runs); under --elastic, also monitor every peer's and
+    # turn lease expiry into a named-rank verdict + agreed stop + restart
+    elastic_runtime = elastic.start(args, step_fn=trainer.get_num_updates)
+
     task.load_dataset(args.train_subset, combine=False, epoch=1)
     extra_state, epoch_itr = restore_session(args, trainer)
 
@@ -308,6 +324,16 @@ def main(args) -> None:
         if profiling:
             jax.profiler.stop_trace()
         session.close()
+        # elastic runtime deliberately NOT stopped here: its monitor keeps
+        # working toward a verdict while a terminal error unwinds, so the
+        # CLI wrapper can reclassify an opaque collective failure as the
+        # named host loss that caused it (cli_main stops it)
+
+    # a host-loss/control-plane verdict stopped the run at an agreed
+    # update and the checkpoint above landed — exit with the RETRYABLE
+    # taxonomy code (never 0) so the supervisor re-forms the run
+    if elastic_runtime is not None:
+        elastic_runtime.raise_if_lost()
 
     logger.info(f"done training in {time.time() - started:.1f} seconds")
 
@@ -535,11 +561,43 @@ def cli_main(modify_parser: Optional[Callable] = None) -> None:
     force_host_cpu_from_env(default_devices=8)
 
     from unicore_tpu import options
+    from unicore_tpu.distributed import elastic
     from unicore_tpu.distributed import utils as distributed_utils
 
     parser = options.get_training_parser()
     args = options.parse_args_and_arch(parser, modify_parser=modify_parser)
-    distributed_utils.call_main(args, main)
+
+    if getattr(args, "elastic", False) and not elastic.is_child():
+        # --elastic: this process becomes the per-host supervisor; training
+        # runs in a child it restarts on retryable failures (the child
+        # re-parses this same argv with the child env marker set)
+        sys.exit(elastic.supervise(args, sys.argv[1:]))
+
+    try:
+        distributed_utils.call_main(args, main)
+    except KeyboardInterrupt:
+        raise
+    except Exception as err:
+        # distinct, documented exit codes for the terminal error taxonomy
+        # (docs/robustness.md "Elastic runs"): external supervisors — k8s,
+        # slurm, the --elastic loop — tell retryable from fatal without
+        # log-grepping.  A dead peer races its own diagnosis, so an
+        # opaque failure first gives the heartbeat monitor one timeout to
+        # name the culprit.  Unclassified errors keep the stock
+        # traceback/rc 1.
+        code = elastic.reclassify_with_verdict(err, elastic.exit_code(err))
+        if code == elastic.EXIT_UNCAUGHT:
+            raise
+        retryable = code in elastic.RETRYABLE_EXIT_CODES
+        logger.error(
+            f"FATAL: {type(err).__name__}: {err} — exiting "
+            f"{code} ({elastic.EXIT_CODE_NAMES[code]}, "
+            f"{'retryable' if retryable else 'not retryable'})",
+            exc_info=True,
+        )
+        sys.exit(code)
+    finally:
+        elastic.stop()
 
 
 if __name__ == "__main__":
