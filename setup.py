@@ -26,6 +26,7 @@ setup(
     entry_points={
         "console_scripts": [
             "unicore-tpu-train = unicore_tpu_cli.train:cli_main",
+            "unicore-tpu-serve = unicore_tpu_cli.serve:cli_main",
             "unicore-tpu-lint = unicore_tpu_cli.lint:main",
         ],
     },

@@ -46,13 +46,17 @@ _FAST_FILES = {
     "test_nan_detector.py",
     "test_softmax_dropout.py",
     "test_fused_norm.py",
+    "test_serve.py",
 }
 
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in _FAST_FILES:
-            item.add_marker(pytest.mark.fast)
+            # slow-marked items in an otherwise-fast file (test_serve's
+            # subprocess e2e) stay out of the quick smoke subset
+            if item.get_closest_marker("slow") is None:
+                item.add_marker(pytest.mark.fast)
 
 
 def pytest_configure(config):

@@ -1067,3 +1067,90 @@ def test_unguarded_kv_wait_home_module_exempt(tmp_path):
         rules=build_rules(["unguarded-kv-wait"]),
     )
     assert rule_names(vs) == ["unguarded-kv-wait"]
+
+
+# ---------------------------------------------------------------------------
+# unbounded-serve-wait
+# ---------------------------------------------------------------------------
+
+
+def _lint_serve_module(tmp_path, source):
+    home = tmp_path / "serve"
+    home.mkdir(exist_ok=True)
+    path = home / "module.py"
+    path.write_text(textwrap.dedent(source))
+    return lint_paths([str(path)], rules=build_rules(["unbounded-serve-wait"]))
+
+
+def test_unbounded_serve_wait_queue_get_and_put(tmp_path):
+    """A no-timeout queue pop and a blocking put inside serve/ can wait
+    forever on a wedged consumer / full queue (positive fixture 1)."""
+    vs = _lint_serve_module(
+        tmp_path,
+        """
+        def pump(q, out_q):
+            item = q.get()
+            out_q.put(item)
+        """,
+    )
+    assert rule_names(vs) == ["unbounded-serve-wait"] * 2
+    joined = " ".join(v.message for v in vs)
+    assert ".get()" in joined and ".put(item)" in joined
+    assert "retry.bounded_wait" in vs[0].message
+
+
+def test_unbounded_serve_wait_event_join_accept(tmp_path):
+    """Timeout-less Event.wait, thread join, and socket accept are the
+    other unbounded shapes (positive fixture 2)."""
+    vs = _lint_serve_module(
+        tmp_path,
+        """
+        def shutdown(done_event, worker, listener, q):
+            done_event.wait()
+            worker.join()
+            q.get(timeout=None)  # queue's explicitly-unbounded spelling
+            return listener.accept()
+        """,
+    )
+    assert rule_names(vs) == ["unbounded-serve-wait"] * 4
+
+
+def test_unbounded_serve_wait_bounded_forms_pass(tmp_path):
+    """Deadline-bounded waits, dict lookups, non-blocking pops, the
+    retry-helper idiom, and the justification comment all stay un-flagged
+    (negative fixture 1)."""
+    vs = _lint_serve_module(
+        tmp_path,
+        """
+        from unicore_tpu.utils import retry
+
+        def pump(q, out_q, d, done_event, worker):
+            x = d.get("key")
+            y = d.get("key", None)
+            item = q.get(timeout=0.5)
+            q.get(block=False)
+            out_q.put(item, timeout=0.5)
+            done_event.wait(timeout=1.0)
+            done_event.wait(0.1)
+            worker.join(2.0)
+            retry.bounded_wait(done_event.is_set, timeout=5.0)
+            return q.get()  # lint: serve-deadline-bounded
+        """,
+    )
+    assert vs == []
+
+
+def test_unbounded_serve_wait_only_in_serve_package(tmp_path):
+    """The same unbounded waits OUTSIDE a serve/ directory are not this
+    rule's business — other subsystems have their own disciplines
+    (negative fixture 2)."""
+    other = tmp_path / "data"
+    other.mkdir()
+    path = other / "module.py"
+    path.write_text(
+        "def pump(q):\n"
+        "    return q.get()\n"
+    )
+    assert lint_paths(
+        [str(path)], rules=build_rules(["unbounded-serve-wait"])
+    ) == []

@@ -1,0 +1,876 @@
+"""Serving plane: admission/shedding math, deadline enforcement at every
+stage, bucket-snap batch formation, the hot-reload verify-then-swap state
+machine (all XLA-free via an injected infer fn), the HTTP transport, the
+serving chaos kinds — plus slow-marked CLI e2e: train → serve → flood →
+shed-with-reason + p99-under-deadline → SIGTERM drain exit 0, and
+corrupt-reload keeping the server on the old snapshot."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from unicore_tpu.checkpoint.emergency import Deadline
+from unicore_tpu.distributed import chaos
+from unicore_tpu.serve import request as rq
+from unicore_tpu.serve.admission import AdmissionQueue
+from unicore_tpu.serve.engine import ServeEngine
+from unicore_tpu.serve.reload import (
+    OUTCOME_REJECTED_PROBE,
+    OUTCOME_REJECTED_STRUCTURE,
+    OUTCOME_REJECTED_VERIFY,
+    OUTCOME_SWAPPED,
+    CheckpointWatcher,
+    HotReloader,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# helpers: an engine with no XLA anywhere near it
+# ---------------------------------------------------------------------------
+
+
+def fake_infer(service_s=0.0, record=None):
+    def infer(variables, arr):
+        if service_s:
+            time.sleep(service_s)
+        if record is not None:
+            record.append(np.asarray(arr).copy())
+        return np.asarray(arr).copy(), np.ones(
+            arr.shape[0], dtype=np.float32
+        )
+
+    return infer
+
+
+class ShapeCountingProbe:
+    """Stand-in for the jit _cache_size() probe: one 'program' per
+    distinct input shape, plus a knob to fake a recompile."""
+
+    def __init__(self):
+        self.shapes = set()
+        self.extra = 0
+
+    def wrap(self, infer):
+        def wrapped(variables, arr):
+            self.shapes.add(tuple(arr.shape))
+            return infer(variables, arr)
+
+        return wrapped
+
+    def __call__(self):
+        return len(self.shapes) + self.extra
+
+
+def make_engine(edges=(16, 32), batch=4, capacity=8, service_s=0.0,
+                record=None, probe=None):
+    infer = fake_infer(service_s, record)
+    if probe is not None:
+        infer = probe.wrap(infer)
+    return ServeEngine(
+        {"params": {"w": np.zeros((2, 2))}},
+        infer,
+        bucket_edges=edges,
+        batch_size=batch,
+        pad_idx=1,
+        admission_capacity=capacity,
+        cache_size_probe=probe,
+    )
+
+
+# ---------------------------------------------------------------------------
+# admission / shedding math
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_sheds_with_reason():
+    q = AdmissionQueue(capacity=2, batch_capacity=4)
+    q.set_accepting(True)
+    reqs = [rq.ServeRequest.make([2, 3], 10.0) for _ in range(3)]
+    assert q.admit(reqs[0]) and q.admit(reqs[1])
+    assert not q.admit(reqs[2])
+    assert reqs[2].done()
+    assert reqs[2].response.status == rq.STATUS_SHED
+    assert reqs[2].response.reason == rq.SHED_QUEUE_FULL
+    assert q.shed_counts == {rq.SHED_QUEUE_FULL: 1}
+    # the two admitted requests are untouched
+    assert not reqs[0].done() and not reqs[1].done()
+
+
+def test_estimated_delay_math():
+    q = AdmissionQueue(capacity=64, batch_capacity=4)
+    q.set_accepting(True)
+    assert q.estimated_delay() == 0.0  # uncalibrated: never sheds on it
+    q.note_batch_service(2.0)
+    # empty queue: the request's own batch = one service time
+    assert q.estimated_delay() == pytest.approx(2.0)
+    for _ in range(8):
+        assert q.admit(rq.ServeRequest.make([2, 3], 1000.0))
+    # 8 queued + this one = 9 -> ceil(9/4) = 3 batches ahead
+    assert q.estimated_delay() == pytest.approx(6.0)
+
+
+def test_deadline_unmeetable_sheds_at_admission():
+    q = AdmissionQueue(capacity=64, batch_capacity=1)
+    q.set_accepting(True)
+    q.note_batch_service(1.0)
+    for _ in range(3):
+        assert q.admit(rq.ServeRequest.make([2, 3], 100.0))
+    # 3 queued batches ahead + own batch = ~4s of queue delay; a 500ms
+    # deadline cannot survive it — shed instead of computing a corpse
+    doomed = rq.ServeRequest.make([2, 3], 0.5)
+    assert not q.admit(doomed)
+    assert doomed.response.reason == rq.SHED_DEADLINE_UNMEETABLE
+    # a patient request still gets in
+    assert q.admit(rq.ServeRequest.make([2, 3], 100.0))
+
+
+def test_admission_state_gates():
+    q = AdmissionQueue(capacity=4, batch_capacity=4, max_len=16)
+    # not yet accepting (warm-up)
+    r1 = rq.ServeRequest.make([2, 3], 10.0)
+    assert not q.admit(r1)
+    assert r1.response.reason == rq.SHED_NOT_READY
+    q.set_accepting(True)
+    # over-long requests can never fit a warmed program
+    r2 = rq.ServeRequest.make(list(range(2, 40)), 10.0)
+    assert not q.admit(r2)
+    assert r2.response.reason == rq.SHED_TOO_LONG
+    # draining is terminal
+    q.begin_drain()
+    r3 = rq.ServeRequest.make([2, 3], 10.0)
+    assert not q.admit(r3)
+    assert r3.response.reason == rq.SHED_DRAINING
+
+
+# ---------------------------------------------------------------------------
+# deadline expiry at each stage
+# ---------------------------------------------------------------------------
+
+
+def test_expired_at_admission():
+    q = AdmissionQueue(capacity=4, batch_capacity=4)
+    q.set_accepting(True)
+    r = rq.ServeRequest.make([2, 3], 0.0)  # already expired
+    assert not q.admit(r)
+    assert r.response.status == rq.STATUS_EXPIRED
+    assert r.response.reason == rq.EXPIRED_AT_ADMISSION
+
+
+def test_expired_in_queue_dropped_from_forming_batch():
+    record = []
+    eng = make_engine(record=record)
+    eng.queue.set_accepting(True)
+    doomed = eng.submit([2, 3, 4], 0.02)
+    live = eng.submit([5, 6], 10.0)
+    time.sleep(0.05)  # doomed's deadline runs out while queued
+    served = eng.step(timeout=0.2)
+    assert served == 1
+    assert doomed.response.status == rq.STATUS_EXPIRED
+    assert doomed.response.reason == rq.EXPIRED_IN_QUEUE
+    assert live.response.status == rq.STATUS_OK
+    # the expired request was dropped, not computed: exactly one dispatch,
+    # and its rows never contain doomed's tokens
+    assert len(record) == 1
+    assert not any(np.array_equal(row[:3], [2, 3, 4]) for row in record[0])
+
+
+def test_expired_at_response():
+    eng = make_engine(service_s=0.15)
+    eng.queue.set_accepting(True)
+    r = eng.submit([2, 3], 0.05)  # expires while the batch computes
+    eng.step(timeout=0.2)
+    assert r.response.status == rq.STATUS_EXPIRED
+    assert r.response.reason == rq.EXPIRED_AT_RESPONSE
+    assert eng.expired_at_response == 1
+    assert eng.queue.shed_counts[rq.EXPIRED_AT_RESPONSE] == 1
+
+
+# ---------------------------------------------------------------------------
+# bucket-snap batch formation
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_snap_batching_groups_by_bucket():
+    record = []
+    eng = make_engine(edges=(16, 32), batch=4, record=record)
+    eng.queue.set_accepting(True)
+    # head (len 3 -> bucket 16) picks the bucket; the len-20 request
+    # (bucket 32) must NOT ride along even though capacity allows it
+    r_a = eng.submit([2] * 3, 10.0)
+    r_big = eng.submit([3] * 20, 10.0)
+    r_b = eng.submit([4] * 10, 10.0)
+    r_c = eng.submit([5] * 5, 10.0)
+    assert eng.step(timeout=0.2) == 3
+    assert record[0].shape == (4, 16)  # fixed batch rows, bucket width
+    for r in (r_a, r_b, r_c):
+        assert r.response.status == rq.STATUS_OK
+        assert r.response.bucket == 16
+    assert not r_big.done()
+    # FIFO order within the bucket: rows 0..2 are a, b, c
+    assert list(record[0][1][:10]) == [4] * 10
+    # dummy row padding is pad_idx
+    assert list(record[0][3]) == [1] * 16
+    # next batch serves the big request at its own bucket
+    assert eng.step(timeout=0.2) == 1
+    assert record[1].shape == (4, 32)
+    assert r_big.response.bucket == 32
+
+
+def test_batch_capacity_respected():
+    eng = make_engine(edges=(16,), batch=2)
+    eng.queue.set_accepting(True)
+    reqs = [eng.submit([2, 3], 10.0) for _ in range(5)]
+    assert eng.step(timeout=0.2) == 2
+    assert eng.step(timeout=0.2) == 2
+    assert eng.step(timeout=0.2) == 1
+    assert all(r.response.status == rq.STATUS_OK for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# warm-up, readiness, recompile watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_compiles_one_program_per_bucket_then_ready():
+    probe = ShapeCountingProbe()
+    eng = make_engine(edges=(16, 32), batch=4, probe=probe)
+    assert not eng.ready() and eng.phase == "warming-up"
+    # pre-warm-up traffic is shed not-ready, never queued
+    early = eng.submit([2, 3], 10.0)
+    assert early.response.reason == rq.SHED_NOT_READY
+    programs = eng.warmup()
+    assert programs == 2  # == bucket count: the acceptance bound
+    assert eng.ready() and eng.phase == "serving"
+    # steady state: more traffic, zero new programs
+    eng.submit([2, 3], 10.0)
+    eng.step(timeout=0.2)
+    assert probe() == 2
+    assert eng.recompiles_after_warmup == 0
+
+
+def test_recompile_after_warmup_warns(caplog):
+    probe = ShapeCountingProbe()
+    eng = make_engine(edges=(16,), batch=4, probe=probe)
+    eng.warmup()
+    eng.submit([2, 3], 10.0)
+    probe.extra = 1  # fake a geometry leak
+    with caplog.at_level("WARNING"):
+        eng.step(timeout=0.2)
+    assert eng.recompiles_after_warmup == 1
+    assert any("recompile after warmup" in m for m in caplog.messages)
+
+
+# ---------------------------------------------------------------------------
+# hot reload: verify-then-swap / rollback state machine (no XLA)
+# ---------------------------------------------------------------------------
+
+
+def _good_state(eng, step=7):
+    return {
+        "model": {
+            "params": {"w": np.ones_like(eng.variables["params"]["w"])}
+        },
+        "optimizer_history": [{"num_updates": step}],
+    }
+
+
+def test_reload_swap_applies_on_batch_boundary():
+    eng = make_engine()
+    eng.warmup()
+    old = eng.variables
+    hr = HotReloader(eng, loader=lambda p: _good_state(eng), prober=lambda v: None)
+    assert hr.consider("/fake/checkpoint_last.pt") == OUTCOME_SWAPPED
+    assert eng.ready()  # readiness restored after the verify window
+    assert eng.variables is old  # NOT yet: swaps land on batch boundaries
+    eng.submit([2, 3], 10.0)
+    eng._apply_pending_swap()
+    assert eng.variables is not old
+    assert eng.reloads_applied == 1
+    assert hr.swapped == 1 and hr.rolled_back == 0
+
+
+def test_reload_verify_failure_rolls_back(caplog):
+    eng = make_engine()
+    eng.warmup()
+    old = eng.variables
+
+    def bad_loader(path):
+        from unicore_tpu.checkpoint.format import CorruptCheckpointError
+
+        raise CorruptCheckpointError("integrity manifest digest mismatch")
+
+    hr = HotReloader(eng, loader=bad_loader, prober=lambda v: None)
+    with caplog.at_level("ERROR"):
+        outcome = hr.consider("/fake/checkpoint_last.pt")
+    assert outcome == OUTCOME_REJECTED_VERIFY
+    assert eng.variables is old
+    assert eng.ready() and eng.phase == "serving"  # still healthy
+    assert hr.rolled_back == 1
+    assert any("RELOAD ROLLBACK" in m for m in caplog.messages)
+    # the server keeps serving on the old snapshot
+    r = eng.submit([2, 3], 10.0)
+    eng.step(timeout=0.2)
+    assert r.response.status == rq.STATUS_OK
+
+
+def test_reload_probe_failure_rolls_back():
+    eng = make_engine()
+    eng.warmup()
+
+    def bad_probe(variables):
+        raise ValueError("probe batch produced non-finite scores")
+
+    hr = HotReloader(
+        eng, loader=lambda p: _good_state(eng), prober=bad_probe
+    )
+    assert hr.consider("/fake/c.pt") == OUTCOME_REJECTED_PROBE
+    assert eng.ready()
+    assert eng._pending_swap is None
+
+
+def test_reload_structure_mismatch_rolls_back():
+    eng = make_engine()
+    eng.warmup()
+    hr = HotReloader(
+        eng,
+        loader=lambda p: {"model": {"params": {"other": np.zeros(3)}}},
+        prober=lambda v: None,
+    )
+    assert hr.consider("/fake/c.pt") == OUTCOME_REJECTED_STRUCTURE
+    # no model tree at all
+    hr2 = HotReloader(eng, loader=lambda p: {}, prober=lambda v: None)
+    assert hr2.consider("/fake/c.pt") == OUTCOME_REJECTED_STRUCTURE
+
+
+def test_engine_probe_rejects_poisoned_weights():
+    eng = make_engine()
+
+    def nan_infer(variables, arr):
+        return arr.copy(), np.full(arr.shape[0], np.nan, dtype=np.float32)
+
+    eng.infer_fn = nan_infer
+    with pytest.raises(ValueError, match="non-finite"):
+        eng.probe(eng.variables)
+
+
+def test_checkpoint_watcher_sees_each_publish_once(tmp_path):
+    path = tmp_path / "checkpoint_last.pt"
+    path.write_bytes(b"v1")
+    w = CheckpointWatcher(str(path))
+    assert w.poll() is None  # the startup version is already being served
+    # a publish (atomic replace, like _publish_one) is seen exactly once —
+    # a rejected candidate must not be re-tried in a hot loop
+    staged = tmp_path / "staged.tmp"
+    staged.write_bytes(b"v2-longer")
+    os.replace(staged, path)
+    assert w.poll() == str(path)
+    assert w.poll() is None
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+
+def test_drain_flushes_queue_with_running_engine():
+    eng = make_engine(edges=(16,), batch=2)
+    eng.warmup()
+    eng.start()
+    reqs = [eng.submit([2, 3], 30.0) for _ in range(7)]
+    assert eng.drain(Deadline(10.0)) is True
+    assert all(r.done() for r in reqs)
+    assert sum(r.response.status == rq.STATUS_OK for r in reqs) == 7
+    # post-drain admission sheds
+    late = eng.submit([2, 3], 10.0)
+    assert late.response.reason == rq.SHED_DRAINING
+
+
+def test_inflight_accounting_keeps_drain_honest():
+    """Regression: a popped-but-unresponded batch must keep the queue
+    non-idle — 'depth 0' alone would let drain exit 0 while the last
+    batch computes un-responded (pop and in-flight increment share one
+    lock, so idle() is an atomic observation)."""
+    q = AdmissionQueue(capacity=8, batch_capacity=4)
+    q.set_accepting(True)
+    q.admit(rq.ServeRequest.make([2, 3], 30.0))
+    assert not q.idle()
+    batch = q.take_batch((16,), 0.1, max_len=16)
+    assert batch is not None
+    # depth is 0 but the batch is in flight: NOT idle
+    assert q.depth() == 0 and q.inflight() == 1
+    assert not q.idle()
+    q.batch_done()
+    assert q.idle()
+
+
+def test_drain_deadline_exceeded_resolves_leftovers():
+    eng = make_engine(edges=(16,), batch=2)  # engine loop NOT started
+    eng.queue.set_accepting(True)
+    reqs = [eng.submit([2, 3], 30.0) for _ in range(3)]
+    assert eng.drain(Deadline(0.1)) is False
+    # every abandoned request still got a terminal named response
+    assert all(r.done() for r in reqs)
+    assert all(r.response.reason == rq.SHED_DRAINING for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# serving chaos kinds
+# ---------------------------------------------------------------------------
+
+
+def _arm(spec):
+    chaos.configure(SimpleNamespace(fault_inject=spec))
+
+
+def test_serve_chaos_specs_parse_and_reject_rank():
+    plan = chaos.parse_fault_spec("request-flood:50@3")
+    assert plan.kind == "request-flood" and plan.param == 50.0
+    assert plan.step == 3
+    for spec in ("request-flood@0@1", "slow-client@0@0", "corrupt-reload@0@1"):
+        with pytest.raises(ValueError, match="serving plane"):
+            chaos.parse_fault_spec(spec)
+
+
+def test_request_flood_window_and_default_qps():
+    _arm("request-flood@0")
+    chaos.note_serve_batch(0)
+    assert chaos.serve_flood_qps() == 200.0  # default QPS
+    chaos.reset()
+    _arm("request-flood:77@5")
+    chaos.note_serve_batch(4)
+    assert chaos.serve_flood_qps() == 0.0  # not at the trigger batch yet
+    chaos.note_serve_batch(5)
+    assert chaos.serve_flood_qps() == 77.0
+
+
+def test_slow_client_consumed_once():
+    _arm("slow-client:2@0")
+    chaos.note_serve_batch(0)
+    assert chaos.take_slow_client_delay() == 2.0
+    assert chaos.take_slow_client_delay() == 0.0  # one poisoned connection
+
+
+def test_corrupt_reload_flips_candidate_once(tmp_path):
+    from unicore_tpu.checkpoint import format as ckpt_format
+    from unicore_tpu.checkpoint.format import CorruptCheckpointError
+
+    path = str(tmp_path / "checkpoint_last.pt")
+    ckpt_format.write({"model": {"w": np.arange(64, dtype=np.float32)}}, path)
+    ckpt_format.read(path)  # pristine file verifies
+    _arm("corrupt-reload@0")
+    chaos.note_serve_batch(0)
+    assert chaos.maybe_corrupt_reload(path) is True
+    with pytest.raises(CorruptCheckpointError):
+        ckpt_format.read(path)
+    # consumed: the next candidate is left alone
+    assert chaos.maybe_corrupt_reload(path) is False
+
+
+def test_corrupt_reload_end_to_end_state_machine(tmp_path):
+    """The full reload path against a REAL v2 file with injected rot:
+    verified load rejects before unpickling, the engine keeps serving."""
+    from unicore_tpu import checkpoint_utils
+    from unicore_tpu.checkpoint import format as ckpt_format
+
+    eng = make_engine()
+    eng.warmup()
+    path = str(tmp_path / "checkpoint_last.pt")
+    ckpt_format.write(
+        {"model": dict(eng.variables), "optimizer_history": []}, path
+    )
+    _arm("corrupt-reload@0")
+    chaos.note_serve_batch(0)
+    hr = HotReloader(
+        eng, loader=checkpoint_utils.load_checkpoint_to_cpu,
+        prober=lambda v: None,
+    )
+    assert hr.consider(path) == OUTCOME_REJECTED_VERIFY
+    r = eng.submit([2, 3], 10.0)
+    eng.step(timeout=0.2)
+    assert r.response.status == rq.STATUS_OK
+
+
+# ---------------------------------------------------------------------------
+# exit-code taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_serve_exit_codes_extend_the_taxonomy():
+    from unicore_tpu.distributed import elastic
+    from unicore_tpu_cli import serve as serve_cli
+
+    codes = {
+        serve_cli.EXIT_SERVE_BIND: 75,
+        serve_cli.EXIT_SERVE_MODEL_LOAD: 76,
+        serve_cli.EXIT_SERVE_DRAIN_DEADLINE: 77,
+    }
+    assert all(k == v for k, v in codes.items())
+    # no collision with the training taxonomy (65-74)
+    assert not set(codes) & set(elastic.EXIT_CODE_NAMES)
+    assert all(c in serve_cli.SERVE_EXIT_CODE_NAMES for c in codes)
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport (fake engine — fast)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_server():
+    from unicore_tpu.serve.http import bind_server
+
+    eng = make_engine(edges=(8, 16), batch=2)
+    server = bind_server(
+        "127.0.0.1", 0, eng,
+        read_timeout_s=1.0, default_deadline_ms=2000.0,
+    )
+    server.start()
+    eng.warmup()
+    eng.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield eng, base
+    eng.stop()
+    server.shutdown()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_probes_and_infer(http_server):
+    eng, base = http_server
+    assert _get(base + "/healthz")[0] == 200
+    code, body = _get(base + "/readyz")
+    assert code == 200 and body["ready"] is True
+    code, body = _post(
+        base + "/v1/infer",
+        {"tokens": [5, 6, 7], "deadline_ms": 5000, "id": "q1"},
+    )
+    assert code == 200
+    assert body["status"] == "ok" and body["output"] == [5, 6, 7]
+    assert body["bucket"] == 8
+    code, stats = _get(base + "/stats")
+    assert code == 200 and stats["served"] == 1
+
+
+def test_http_bad_request_and_unknown_path(http_server):
+    _, base = http_server
+    assert _post(base + "/v1/infer", {"tokens": []})[0] == 400
+    assert _post(base + "/v1/infer", {"nope": 1})[0] == 400
+    # invalid token payloads are a named 400, never a handler traceback
+    # with no HTTP response (regression)
+    assert _post(base + "/v1/infer", {"tokens": ["abc"]})[0] == 400
+    assert _post(base + "/v1/infer", {"tokens": [[1, 2], [3]]})[0] == 400
+    assert _post(base + "/v1/infer", {"tokens": [2 ** 40]})[0] == 400
+    # non-numeric deadline is a named 400 too, not a handler traceback
+    assert _post(
+        base + "/v1/infer", {"tokens": [5], "deadline_ms": "fast"}
+    )[0] == 400
+    assert _get(base + "/nope")[0] == 404
+
+
+def test_http_slow_client_gets_408_not_a_wedged_worker(http_server):
+    _, base = http_server
+    _arm("slow-client:30@0")
+    chaos.note_serve_batch(0)
+    t0 = time.monotonic()
+    code, body = _post(base + "/v1/infer", {"tokens": [5, 6]})
+    elapsed = time.monotonic() - t0
+    assert code == 408
+    assert body["reason"] == "slow-client"
+    # bounded by the 1s read budget, not the 30s stall
+    assert elapsed < 10.0
+    # the poisoned connection is consumed: the next request is normal
+    assert _post(base + "/v1/infer", {"tokens": [5, 6]})[0] == 200
+
+
+def test_http_explicit_zero_deadline_is_expired_not_default(http_server):
+    """Regression: 'deadline_ms': 0 means ALREADY EXPIRED (Deadline's own
+    contract) — a truthiness check would silently substitute the server
+    default and serve a request the client already gave up on."""
+    _, base = http_server
+    code, body = _post(base + "/v1/infer", {"tokens": [5, 6], "deadline_ms": 0})
+    assert code == 504
+    assert body["status"] == "expired"
+    assert body["reason"] == rq.EXPIRED_AT_ADMISSION
+
+
+def test_http_shed_maps_to_503_during_drain(http_server):
+    eng, base = http_server
+    eng.queue.begin_drain()
+    code, body = _post(base + "/v1/infer", {"tokens": [5, 6]})
+    assert code == 503
+    assert body["status"] == "shed" and body["reason"] == "draining"
+
+
+# ---------------------------------------------------------------------------
+# CLI e2e (slow): the real model, the real HTTP plane, real signals
+# ---------------------------------------------------------------------------
+
+_SCALE = float(os.environ.get("UNICORE_TPU_TEST_TIMEOUT_SCALE", "0")) or (
+    3.0 if (os.cpu_count() or 2) <= 1 else 1.0
+)
+CLI_TIMEOUT = int(600 * _SCALE)
+_JAX_CACHE = os.environ.get(
+    "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_e2e_jaxcache"
+)
+
+_RUNNER = r"""
+import os, sys
+os.environ["UNICORE_TPU_PLATFORM"] = "cpu"
+os.environ["UNICORE_TPU_CPU_DEVICES"] = "1"
+sys.path.insert(0, {repo!r})
+sys.argv = [{prog!r}] + {argv!r}
+from unicore_tpu_cli.{module} import cli_main
+cli_main()
+"""
+
+
+def _runner_cmd(module, argv):
+    return [
+        sys.executable, "-c",
+        _RUNNER.format(repo=REPO, prog=module, argv=argv, module=module),
+    ]
+
+
+@pytest.fixture(scope="module")
+def served_checkpoint(tmp_path_factory):
+    """Train 2 updates of bert_tiny and hand back the checkpoint dir."""
+    root = tmp_path_factory.mktemp("serve_e2e")
+    data = root / "data"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "bert", "make_example_data.py"),
+         str(data), "64", "40"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    argv = [
+        str(data),
+        "--task", "bert", "--loss", "masked_lm", "--arch", "bert_tiny",
+        "--optimizer", "adam", "--lr-scheduler", "polynomial_decay",
+        "--lr", "1e-3", "--warmup-updates", "1",
+        "--total-num-update", "2", "--max-update", "2",
+        "--max-epoch", "10", "--batch-size", "4", "--max-seq-len", "64",
+        "--log-interval", "1", "--log-format", "simple",
+        "--save-dir", str(root / "ckpt"), "--tmp-save-dir", str(root / "tmp"),
+        "--num-workers", "0", "--seed", "1", "--no-progress-bar",
+        "--disable-validation", "--required-batch-size-multiple", "1",
+        "--jax-compilation-cache-dir", _JAX_CACHE,
+    ]
+    proc = subprocess.run(
+        _runner_cmd("train", argv), capture_output=True, text=True,
+        timeout=CLI_TIMEOUT, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    ckpt = root / "ckpt" / "checkpoint_last.pt"
+    assert ckpt.exists()
+    return ckpt
+
+
+class ServeProc:
+    """A running unicore-tpu-serve subprocess with log + port discovery."""
+
+    def __init__(self, tmp_path, extra_argv):
+        self.log_path = tmp_path / "serve.log"
+        self._log = open(self.log_path, "w")
+        self.proc = subprocess.Popen(
+            _runner_cmd("serve", extra_argv),
+            stdout=self._log, stderr=subprocess.STDOUT, cwd=REPO,
+        )
+        self.base = None
+
+    def log(self):
+        with open(self.log_path) as f:
+            return f.read()
+
+    def wait_listening(self, budget):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            log = self.log()
+            for line in log.splitlines():
+                if "SERVE listening" in line:
+                    port = line.rsplit(":", 1)[1].split()[0].strip("/")
+                    self.base = f"http://127.0.0.1:{port}"
+                    return self.base
+            assert self.proc.poll() is None, f"serve died:\n{log[-4000:]}"
+            time.sleep(0.5)
+        raise AssertionError(f"never listened:\n{self.log()[-4000:]}")
+
+    def wait_ready(self, budget):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            try:
+                code, body = _get(self.base + "/readyz")
+                if code == 200 and body.get("ready"):
+                    return
+            except Exception:
+                pass
+            assert self.proc.poll() is None, (
+                f"serve died:\n{self.log()[-4000:]}"
+            )
+            time.sleep(0.5)
+        raise AssertionError(f"never ready:\n{self.log()[-4000:]}")
+
+    def sigterm_and_wait(self, budget):
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            rc = self.proc.wait(timeout=budget)
+        finally:
+            if self.proc.poll() is None:
+                self.proc.kill()
+            self._log.close()
+        return rc
+
+
+@pytest.mark.slow
+def test_cli_serve_flood_shed_p99_and_sigterm_drain(
+    served_checkpoint, tmp_path
+):
+    """Acceptance e2e: under request-flood the server sheds with named
+    reasons while admitted requests' p99 stays under the deadline; SIGTERM
+    drains in-flight work and exits 0 within --drain-deadline; and the
+    steady state logs ZERO recompile-after-warmup warnings."""
+    deadline_ms = 2000.0
+    sp = ServeProc(tmp_path, [
+        "--path", str(served_checkpoint),
+        "--port", "0", "--serve-batch-size", "1", "--serve-buckets", "2",
+        "--admission-capacity", "16",
+        "--default-deadline-ms", str(deadline_ms),
+        "--drain-deadline", str(60 * _SCALE),
+        "--fault-inject", "request-flood:2000@0",
+        "--jax-compilation-cache-dir", _JAX_CACHE,
+    ])
+    try:
+        sp.wait_listening(60 * _SCALE)
+        sp.wait_ready(180 * _SCALE)
+        # the flood generator opens its 10s window at readiness and
+        # saturates the batch-size-1 service capacity; this real request
+        # rides along (it may itself be shed — that's the point)
+        _post(
+            sp.base + "/v1/infer",
+            {"tokens": [5, 6, 7], "deadline_ms": 5000},
+        )
+        deadline = time.monotonic() + 90 * _SCALE
+        stats = {}
+        while time.monotonic() < deadline:
+            _, stats = _get(sp.base + "/stats")
+            if stats.get("shed"):
+                break
+            time.sleep(0.5)
+        assert stats.get("shed"), f"flood never shed: {stats}\n{sp.log()[-3000:]}"
+        shed_reasons = set(stats["shed"])
+        assert shed_reasons & {"queue-full", "deadline-unmeetable"}, stats
+        # let the flood window close and the queue settle, then check the
+        # admitted requests' latency held the line
+        time.sleep(3)
+        _, stats = _get(sp.base + "/stats")
+    finally:
+        rc = sp.sigterm_and_wait(120 * _SCALE)
+    log = sp.log()
+    sys.stdout.write(log)  # CI smoke greps the serve log via pytest -s
+    assert rc == 0, f"drain exit {rc}:\n{log[-4000:]}"
+    assert "SHED request" in log
+    assert "DRAIN complete" in log
+    assert "recompile after warmup" not in log
+    assert stats.get("served", 0) >= 1
+    assert stats.get("p99_ms", 1e9) < deadline_ms, stats
+
+
+@pytest.mark.slow
+def test_cli_serve_corrupt_reload_keeps_serving(served_checkpoint, tmp_path):
+    """Acceptance e2e: a corrupt hot-reload candidate is rejected by the
+    verified load, the server ROLLS BACK and keeps answering from the old
+    snapshot; a subsequent intact publish swaps cleanly."""
+    import shutil
+
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    live = ckpt_dir / "checkpoint_last.pt"
+    shutil.copy(served_checkpoint, live)
+    pristine = tmp_path / "pristine.pt"
+    shutil.copy(served_checkpoint, pristine)
+
+    def publish():
+        staged = ckpt_dir / ".staged.tmp"
+        shutil.copy(pristine, staged)
+        os.replace(staged, live)
+
+    sp = ServeProc(tmp_path, [
+        "--path", str(live),
+        "--port", "0", "--serve-batch-size", "2", "--serve-buckets", "2",
+        "--reload-interval", "0.5",
+        "--drain-deadline", str(60 * _SCALE),
+        "--fault-inject", "corrupt-reload@0",
+        "--jax-compilation-cache-dir", _JAX_CACHE,
+    ])
+    try:
+        sp.wait_listening(60 * _SCALE)
+        sp.wait_ready(180 * _SCALE)
+        code, _ = _post(sp.base + "/v1/infer", {"tokens": [5, 6, 7]})
+        assert code == 200
+        # publish #1: picked up as a reload candidate, rotted by chaos,
+        # rejected by the manifest check -> rollback
+        publish()
+        deadline = time.monotonic() + 60 * _SCALE
+        while time.monotonic() < deadline:
+            if "RELOAD ROLLBACK" in sp.log():
+                break
+            time.sleep(0.5)
+        log = sp.log()
+        assert "RELOAD ROLLBACK" in log, log[-3000:]
+        assert "rejected:verify" in log
+        # the server keeps answering from the serving snapshot
+        code, body = _post(sp.base + "/v1/infer", {"tokens": [8, 9]})
+        assert code == 200 and body["status"] == "ok"
+        # publish #2: chaos is consumed; the intact candidate verifies,
+        # probes, and swaps on a batch boundary
+        publish()
+        deadline = time.monotonic() + 60 * _SCALE
+        while time.monotonic() < deadline:
+            if "RELOAD VERIFIED" in sp.log():
+                break
+            time.sleep(0.5)
+        assert "RELOAD VERIFIED" in sp.log(), sp.log()[-3000:]
+        # a request after the swap still answers (and forces the boundary
+        # where the swap lands)
+        code, _ = _post(sp.base + "/v1/infer", {"tokens": [8, 9, 10]})
+        assert code == 200
+    finally:
+        rc = sp.sigterm_and_wait(120 * _SCALE)
+    sys.stdout.write(sp.log())  # CI smoke greps the serve log via pytest -s
+    assert rc == 0, sp.log()[-4000:]

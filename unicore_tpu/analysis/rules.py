@@ -945,6 +945,132 @@ class UnguardedKvWait(LintRule):
 
 
 # ---------------------------------------------------------------------------
+# 8b. unbounded-serve-wait
+# ---------------------------------------------------------------------------
+
+# the serving plane (unicore_tpu/serve/) promises every blocking wait is
+# deadline-bounded (docs/serving.md): a slow client, a wedged consumer,
+# or a dead engine thread must surface as a diagnosable timeout, never
+# an unbounded block holding a worker hostage.  This rule flags the
+# UNBOUNDED form of each common blocking wait inside serve/ modules:
+#
+#   .get()                 queue pop with no timeout (dict.get(key) has a
+#                          positional arg and stays un-flagged)
+#   .put(item)             queue push that can block forever on a full
+#                          queue (bounded forms pass timeout= or
+#                          block=False)
+#   .wait()                Event/Condition wait with no timeout
+#   .join()                thread join with no timeout (str.join(seq) has
+#                          an arg and stays un-flagged)
+#   .accept()              socket accept with no settimeout visible
+#
+# Sanctioned shapes: a timeout argument on the call itself, or routing
+# through utils/retry.py (bounded_wait / kv_wait poll in deadline-bounded
+# slices).  '# lint: serve-deadline-bounded' justifies a call whose bound
+# lives elsewhere (e.g. a socket with settimeout set at setup).
+_SERVE_HOME = "serve"
+
+
+def _in_serve_package(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return _SERVE_HOME in parts[:-1]
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    """True for a ``name=`` keyword whose value is not the constant None —
+    ``q.get(timeout=None)`` is the queue's explicitly-unbounded spelling,
+    exactly the hang this rule exists to catch."""
+    for kw in call.keywords:
+        if kw.arg == name:
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            )
+    return False
+
+
+def _kwarg_is_false(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    return False
+
+
+@register_lint_rule("unbounded-serve-wait")
+class UnboundedServeWait(LintRule):
+    name = "unbounded-serve-wait"
+    justifications = ("serve-deadline-bounded",)
+    description = (
+        "unbounded blocking wait (queue get/put, event/condition wait, "
+        "join, socket accept without a timeout) inside unicore_tpu/serve/:"
+        " the serving plane promises every wait is deadline-bounded — a "
+        "slow client or wedged consumer must time out with a named "
+        "reason, never hold a worker forever.  Pass a timeout, route "
+        "through utils/retry.bounded_wait, or justify a call bounded "
+        "elsewhere with '# lint: serve-deadline-bounded'"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        if not _in_serve_package(module.path):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            why = self._unbounded_wait(func.attr, node)
+            if why is not None:
+                yield _v(
+                    self,
+                    module,
+                    node,
+                    f"{why} — pass a timeout, use retry.bounded_wait, or "
+                    "justify with '# lint: serve-deadline-bounded'",
+                )
+
+    @staticmethod
+    def _unbounded_wait(attr: str, call: ast.Call) -> Optional[str]:
+        if _has_kwarg(call, "timeout"):
+            return None
+        if attr == "get":
+            # zero-positional .get() is a queue pop; dict.get(key) and
+            # .get(key, default) carry positionals
+            if not call.args and not _kwarg_is_false(call, "block"):
+                return (
+                    "blocking .get() with no timeout can wait forever on "
+                    "an empty queue"
+                )
+        elif attr == "put":
+            # q.put(item) blocks forever on a full queue — the exact
+            # unbounded-buffering failure admission control exists to
+            # prevent; put(item, block) with 2 positionals is explicit
+            if len(call.args) == 1 and not _kwarg_is_false(call, "block"):
+                return (
+                    "blocking .put(item) with no timeout can wait forever "
+                    "on a full queue"
+                )
+        elif attr == "wait":
+            if not call.args:
+                return (
+                    ".wait() with no timeout blocks until another thread "
+                    "cooperates — which a dead engine thread never will"
+                )
+        elif attr == "join":
+            if not call.args:
+                return (
+                    ".join() with no timeout blocks shutdown behind a "
+                    "thread that may never exit"
+                )
+        elif attr == "accept":
+            if not call.args:
+                return (
+                    ".accept() with no visible timeout blocks the "
+                    "listener forever on a quiet socket"
+                )
+        return None
+
+
+# ---------------------------------------------------------------------------
 # 9. raw-checkpoint-write
 # ---------------------------------------------------------------------------
 

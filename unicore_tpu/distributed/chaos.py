@@ -70,6 +70,25 @@ Kinds (all persistent from STEP onward unless noted):
     30) from STEP onward, on EVERY rank (the outage is a property of the
     service, not a host).  Proves every KV wait is deadline-bounded
     through ``utils/retry.py`` — bounded blocking, never a hang.
+``request-flood[:QPS]@STEP``
+    Serving plane only: from serve-batch STEP onward the CLI's synthetic
+    traffic generator offers QPS (default 200) requests per second for a
+    fixed 10s window.  Proves the admission queue sheds with named
+    reasons under overload while admitted requests keep their deadlines
+    — never unbounded buffering.
+``slow-client[:SECS]@STEP``
+    Serving plane only: ONE request after serve-batch STEP arrives from
+    a client that stalls SECS (default 5) mid-body.  Proves the HTTP
+    read path is deadline-bounded (408 with a named reason), so one slow
+    client can never wedge a server worker.  Consumed after one request.
+``corrupt-reload@STEP``
+    Serving plane only: the NEXT hot-reload candidate checkpoint picked
+    up after serve-batch STEP gets payload bytes bit-flipped before the
+    verified load reads it (the same rot machinery as
+    ``bit-flip-checkpoint``).  Proves verify-then-swap rolls back and
+    the server keeps answering from the serving snapshot — a corrupt
+    reload must never take down a healthy server.  Consumed after one
+    candidate.
 
 The three elastic kinds above arm only on the FIRST incarnation of an
 elastic run (membership epoch 0, restart count 0): a restarted child
@@ -111,7 +130,15 @@ KINDS = (
     "host-loss",
     "heartbeat-stall",
     "kv-outage",
+    "request-flood",
+    "slow-client",
+    "corrupt-reload",
 )
+
+# serving-plane kinds (consumed by unicore_tpu/serve/ + the serve CLI);
+# serving is single-process, so every one of them fires on "this" rank —
+# @RANK targeting is meaningless and rejected
+_SERVE_KINDS = ("request-flood", "slow-client", "corrupt-reload")
 
 # metric-fault kinds perturb REPLICATED jit inputs, so they must fire
 # identically on every rank — @RANK targeting is rejected for them
@@ -167,6 +194,11 @@ class FaultPlan:
                 "service, which every rank experiences at once; drop the "
                 "@RANK part"
             )
+        if kind in _SERVE_KINDS and rank is not None:
+            raise ValueError(
+                f"'{kind}' targets the single-process serving plane; "
+                "drop the @RANK part"
+            )
         self.kind = kind
         self.step = step
         self._rank = rank  # None = resolve to last rank at trigger time
@@ -189,7 +221,11 @@ class FaultPlan:
         return jax.process_count() - 1
 
     def on_this_rank(self) -> bool:
-        if self.kind in _ALL_RANK_KINDS or self.kind in _SERVICE_KINDS:
+        if (
+            self.kind in _ALL_RANK_KINDS
+            or self.kind in _SERVICE_KINDS
+            or self.kind in _SERVE_KINDS
+        ):
             return True
         import jax
 
@@ -200,6 +236,8 @@ class FaultPlan:
         return step >= self.step and self.on_this_rank()
 
     def __repr__(self):
+        if self.kind in _SERVE_KINDS:
+            return f"FaultPlan({self.kind}@{self.step}@serve)"
         if self.kind in _ALL_RANK_KINDS or self.kind in _SERVICE_KINDS:
             return f"FaultPlan({self.kind}@{self.step}@all-ranks)"
         if self._rank is not None:
@@ -408,21 +446,7 @@ def maybe_bit_flip_checkpoint(path: str) -> None:
 
     nbytes = int(_plan.param) if _plan.param is not None else _DEFAULT_FLIP_BYTES
     try:
-        size = os.path.getsize(path)
-        from unicore_tpu.checkpoint import format as ckpt_format
-
-        bounds = ckpt_format.payload_bounds(path)
-        lo, hi = bounds if bounds is not None else (size // 4, size)
-        span = max(1, hi - lo)
-        with open(path, "r+b") as f:
-            for i in range(nbytes):
-                # deterministic spread across the payload (midpoints of
-                # nbytes equal slices) — reproducible without host RNG
-                off = lo + (span * (2 * i + 1)) // (2 * nbytes)
-                f.seek(off)
-                byte = f.read(1)
-                f.seek(off)
-                f.write(bytes([byte[0] ^ 0x01]))
+        _flip_payload_bytes(path, nbytes)
         logger.warning(
             f"chaos: flipped {nbytes} payload byte(s) of checkpoint "
             f"{path} (silent bit rot at rest; a v1 pickle would resume "
@@ -430,6 +454,30 @@ def maybe_bit_flip_checkpoint(path: str) -> None:
         )
     except OSError as e:  # directory checkpoints (orbax) are not flippable
         logger.warning(f"chaos: could not bit-flip {path}: {e}")
+
+
+def _flip_payload_bytes(path: str, nbytes: int) -> None:
+    """Flip ``nbytes`` bytes inside the manifested payload region of a
+    checkpoint file — the shared rot mechanics of ``bit-flip-checkpoint``
+    (write-side rot at rest) and ``corrupt-reload`` (rot on the serving
+    plane's reload candidate)."""
+    import os
+
+    size = os.path.getsize(path)
+    from unicore_tpu.checkpoint import format as ckpt_format
+
+    bounds = ckpt_format.payload_bounds(path)
+    lo, hi = bounds if bounds is not None else (size // 4, size)
+    span = max(1, hi - lo)
+    with open(path, "r+b") as f:
+        for i in range(nbytes):
+            # deterministic spread across the payload (midpoints of
+            # nbytes equal slices) — reproducible without host RNG
+            off = lo + (span * (2 * i + 1)) // (2 * nbytes)
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ 0x01]))
 
 
 def maybe_disk_full(path: str) -> None:
@@ -525,6 +573,102 @@ def kv_outage_active() -> bool:
     inside utils/retry.py's KV helpers, so every consumer experiences the
     outage — and must stay deadline-bounded through it."""
     return _windowed_active("kv-outage", _DEFAULT_KV_OUTAGE_SECONDS)
+
+
+# ---------------------------------------------------------------------------
+# serving-plane kinds (unicore_tpu/serve/, docs/serving.md)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_FLOOD_QPS = 200.0
+_FLOOD_WINDOW_SECONDS = 10.0
+_DEFAULT_SLOW_CLIENT_SECONDS = 5.0
+
+
+def note_serve_batch(seq: int) -> None:
+    """Record serving progress: the serving plane has no training steps,
+    so its step-keyed chaos triggers count dispatched serve batches
+    instead (``@0`` = from startup)."""
+    global _last_step
+    _last_step = seq
+
+
+def serve_flood_qps() -> float:
+    """``request-flood``: target synthetic request rate while the flood
+    window is open, else 0.0.  The [:QPS] param is the RATE (default
+    200/s); the window is a fixed 10s — long enough to saturate any
+    admission queue, short enough that the smoke run's post-flood drain
+    still proves recovery."""
+    global _window_started
+    if (
+        _plan is None
+        or _plan.kind != "request-flood"
+        or not _plan.active(_last_step)
+    ):
+        return 0.0
+    if _window_started is None:
+        _window_started = time.monotonic()
+        logger.warning(
+            f"chaos: request-flood window OPEN at serve batch {_last_step} "
+            f"({_plan.param if _plan.param is not None else _DEFAULT_FLOOD_QPS:g}"
+            f" req/s for {_FLOOD_WINDOW_SECONDS:g}s)"
+        )
+    if time.monotonic() - _window_started >= _FLOOD_WINDOW_SECONDS:
+        return 0.0
+    return float(
+        _plan.param if _plan.param is not None else _DEFAULT_FLOOD_QPS
+    )
+
+
+def take_slow_client_delay() -> float:
+    """``slow-client``: stall seconds to inject into the NEXT request's
+    body read, else 0.0.  Consumed once — one poisoned connection proves
+    the read deadline; stalling every request would just be a flood."""
+    if (
+        _plan is None
+        or _plan.kind != "slow-client"
+        or _plan.consumed
+        or not _plan.active(_last_step)
+    ):
+        return 0.0
+    _plan.consumed = True
+    delay = float(
+        _plan.param
+        if _plan.param is not None
+        else _DEFAULT_SLOW_CLIENT_SECONDS
+    )
+    logger.warning(
+        f"chaos: slow-client — the next request's body stalls {delay:.1f}s "
+        "mid-read (the bounded read path must 408 it, not wedge a worker)"
+    )
+    return delay
+
+
+def maybe_corrupt_reload(path: str) -> bool:
+    """``corrupt-reload``: bit-flip payload bytes of a hot-reload
+    candidate checkpoint before the verified load reads it.  Returns True
+    when the flip happened.  Consumed once — the reload watcher must
+    reject THIS candidate, roll back to the serving snapshot, and keep
+    answering; corrupting every future candidate would make the test
+    prove nothing new while blocking recovery forever."""
+    if (
+        _plan is None
+        or _plan.kind != "corrupt-reload"
+        or _plan.consumed
+        or not _plan.active(_last_step)
+    ):
+        return False
+    _plan.consumed = True
+    try:
+        _flip_payload_bytes(path, _DEFAULT_FLIP_BYTES)
+    except OSError as e:
+        logger.warning(f"chaos: could not corrupt reload candidate {path}: {e}")
+        return False
+    logger.warning(
+        f"chaos: corrupt-reload — flipped payload byte(s) of reload "
+        f"candidate {path}; the verified load must reject it and the "
+        "server must keep serving the old snapshot"
+    )
+    return True
 
 
 def maybe_raise(step: int) -> None:

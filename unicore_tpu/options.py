@@ -81,6 +81,117 @@ def get_training_parser(default_task=None):
     return parser
 
 
+def get_serving_parser():
+    """Parser for ``unicore-tpu-serve`` (unicore_tpu_cli/serve.py).
+
+    Deliberately NOT the two-phase training parser: the model
+    architecture, task, and dictionary all come from the checkpoint's
+    saved args — the operator points at a checkpoint and tunes only the
+    serving-plane knobs."""
+    parser = argparse.ArgumentParser(
+        description="unicore-tpu-serve: continuous-batching inference "
+        "server (docs/serving.md)",
+        allow_abbrev=False,
+    )
+    add_serving_args(parser)
+    return parser
+
+
+def add_serving_args(parser):
+    group = parser.add_argument_group("serving")
+    group.add_argument("--path", metavar="FILE", required=True,
+                       help="checkpoint to serve (v2 checkpoints are "
+                            "CRC-verified before unpickling; the model/"
+                            "task config is read from the saved args)")
+    group.add_argument("--data", metavar="DIR", default=None,
+                       help="override the data dir recorded in the "
+                            "checkpoint (the task dictionary loads from "
+                            "here)")
+    group.add_argument("--host", default="127.0.0.1",
+                       help="bind address for the HTTP plane")
+    group.add_argument("--port", type=int, default=8693, metavar="N",
+                       help="bind port (0 = pick an ephemeral port; the "
+                            "chosen port is logged on the 'SERVE "
+                            "listening' line)")
+    group.add_argument("--serve-batch-size", type=int, default=8,
+                       metavar="N",
+                       help="fixed micro-batch rows per dispatched batch; "
+                            "with --serve-buckets this bounds compiled "
+                            "programs to the bucket count (short batches "
+                            "are padded with dummy rows, never reshaped)")
+    group.add_argument("--serve-buckets", type=int, default=4, metavar="N",
+                       help="number of padded sequence-length buckets "
+                            "covering the model's --max-seq-len (same "
+                            "bucketing as training's --length-bucket): "
+                            "warm-up compiles exactly one program per "
+                            "bucket and admission sheds requests longer "
+                            "than the largest bucket")
+    group.add_argument("--admission-capacity", type=int, default=256,
+                       metavar="N",
+                       help="bounded admission queue depth; a full queue "
+                            "sheds 'queue-full' — the server NEVER "
+                            "buffers unboundedly")
+    group.add_argument("--default-deadline-ms", type=float, default=1000.0,
+                       metavar="MS",
+                       help="per-request deadline when the request body "
+                            "carries none; enforced at admission, batch "
+                            "formation, and response")
+    group.add_argument("--max-deadline-ms", type=float, default=60000.0,
+                       metavar="MS",
+                       help="ceiling clamped onto client-supplied "
+                            "deadlines (an absurd deadline is an "
+                            "unbounded-buffering bug in disguise)")
+    group.add_argument("--request-read-timeout", type=float, default=10.0,
+                       metavar="SECS",
+                       help="budget for reading one request body; a "
+                            "client stalling past it gets 408 "
+                            "('slow-client') instead of wedging a worker")
+    group.add_argument("--drain-deadline", type=float, default=30.0,
+                       metavar="SECS",
+                       help="SIGTERM graceful-drain budget: stop "
+                            "admitting, flush in-flight batches, exit 0; "
+                            "exceeding it exits 77 and the leftovers get "
+                            "named 'draining' responses (a second signal "
+                            "aborts immediately)")
+    group.add_argument("--reload-interval", type=float, default=0.0,
+                       metavar="SECS",
+                       help="hot checkpoint reload: poll --path's "
+                            "publish signature this often and "
+                            "verify-then-swap new checkpoints on a batch "
+                            "boundary, rolling back (and continuing to "
+                            "serve the old snapshot) if verification or "
+                            "the probe batch fails (0 disables)")
+    group.add_argument("--serve-max-seconds", type=float, default=0.0,
+                       metavar="SECS",
+                       help="auto-drain and exit after this long "
+                            "(0 = serve until signalled; smoke tests use "
+                            "this to bound chaos runs)")
+    group.add_argument("--jax-compilation-cache-dir", default=None,
+                       metavar="DIR",
+                       help="persistent XLA compile cache (shared with "
+                            "training): restarts reload their bucket "
+                            "programs instead of recompiling")
+    group.add_argument("--fault-inject", type=str, default=None,
+                       metavar="KIND[:PARAM]@STEP",
+                       help="serving chaos harness (distributed/chaos.py):"
+                            " request-flood[:QPS] (synthetic overload, "
+                            "proves named-reason shedding), "
+                            "slow-client[:SECS] (one stalled body read, "
+                            "proves the bounded read path), "
+                            "corrupt-reload (bit rot on the next reload "
+                            "candidate, proves verify-then-swap rollback);"
+                            " STEP counts dispatched serve batches")
+    group.add_argument("--seed", type=int, default=1, metavar="N",
+                       help="accepted for script compatibility with the "
+                            "training CLI; serving is deterministic (eval-"
+                            "mode forwards, constant warm-up dummies) and "
+                            "consumes no rng")  # lint: compat-flag
+    group.add_argument("--no-progress-bar", action="store_true",
+                       help="accepted for script compatibility with the "
+                            "training CLI")  # lint: compat-flag
+    return group
+
+
 def get_validation_parser(default_task=None):
     parser = get_parser("Validation", default_task)
     add_dataset_args(parser, train=True)

@@ -1,0 +1,36 @@
+"""The serving plane: continuous-batching inference built from what
+training already earned.
+
+Shape buckets + the persistent compile cache bound warm-up (one XLA
+program per bucket, compiled before readiness flips true); checkpoints
+enter ONLY through the read-verified v2 path (CRC before unpickle); the
+PR-5 ``Deadline`` machinery carries per-request deadlines enforced at
+admission, at batch formation, and at response; a bounded admission
+queue sheds overload with named reasons; SIGTERM drains in-flight work
+under a deadline; and hot reload verify-then-swaps new checkpoints with
+rollback — a corrupt reload never takes down a healthy server.
+
+See docs/serving.md for the full protocol;
+``unicore_tpu_cli/serve.py`` (``unicore-tpu-serve``) is the operator
+entry point.
+"""
+
+from unicore_tpu.serve.admission import AdmissionQueue
+from unicore_tpu.serve.engine import ServeEngine, build_infer_fn
+from unicore_tpu.serve.reload import (
+    CheckpointWatcher,
+    HotReloader,
+    ReloadRunner,
+)
+from unicore_tpu.serve.request import ServeRequest, ServeResponse
+
+__all__ = [
+    "AdmissionQueue",
+    "CheckpointWatcher",
+    "HotReloader",
+    "ReloadRunner",
+    "ServeEngine",
+    "ServeRequest",
+    "ServeResponse",
+    "build_infer_fn",
+]

@@ -1,0 +1,262 @@
+"""Bounded admission queue with explicit load shedding.
+
+The serving plane's first robustness rule: **never buffer unboundedly**.
+Overload has exactly one sanctioned outcome — an immediate rejection with
+a named reason — because an unbounded queue converts overload into
+latency for EVERY request (the queue keeps accepting work it can never
+finish in time) and eventually into host-RAM death.  Admission enforces
+three gates, in order:
+
+1. server state: a draining or not-yet-warm server sheds on sight
+   (``draining`` / ``not-ready``);
+2. capacity: a full queue sheds ``queue-full``;
+3. deadline feasibility: a request whose deadline cannot survive the
+   ESTIMATED queue delay (queue depth / batch capacity x the engine's
+   EMA batch-service time) sheds ``deadline-unmeetable`` — rejecting at
+   admission is strictly kinder than computing a response nobody can use.
+
+Deadlines are enforced again at batch formation (:meth:`take_batch` drops
+expired requests from a forming batch — they are never computed) and a
+third time at response (the engine marks a result that missed its
+deadline ``expired-at-response``).
+
+Batch formation is bucket-affine: the head request picks the shape bucket
+(see ``data_utils.compute_length_buckets``) and the queue is scanned
+FIFO for more requests snapping to the same bucket, so every dispatched
+batch reuses one of the warmed XLA programs — continuous batching that
+can never mint a new geometry.
+"""
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+from unicore_tpu.data.data_utils import bucket_for
+from unicore_tpu.serve import request as rq
+
+logger = logging.getLogger(__name__)
+
+
+class AdmissionQueue:
+    """Bounded FIFO of admitted :class:`~unicore_tpu.serve.request.ServeRequest`s
+    with shedding, deadline-feasibility estimation, and bucket-affine
+    batch formation."""
+
+    def __init__(self, capacity: int, *, batch_capacity: int = 8,
+                 max_len: int = 0, service_ema_alpha: float = 0.2):
+        self.capacity = int(capacity)
+        self.batch_capacity = max(1, int(batch_capacity))
+        #: longest admissible request (0 = unchecked); anything longer can
+        #: never fit a warmed program and sheds at the door
+        self.max_len = int(max_len)
+        self._alpha = float(service_ema_alpha)
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        #: EMA of one batch's service time (seconds); None until the
+        #: engine has dispatched a batch (warm-up seeds it)
+        self._service_ema: Optional[float] = None
+        self._accepting = False
+        self._draining = False
+        # batches popped but not yet fully responded (engine calls
+        # batch_done); incremented under the SAME lock as the pop, so
+        # "queue empty AND nothing in flight" is an atomic observation —
+        # the drain-complete predicate depends on it
+        self._inflight = 0
+        # shed/expiry accounting (per reason, for /stats and the smokes)
+        self.shed_counts = {}
+        self.admitted = 0
+
+    # -- state gates -----------------------------------------------------
+
+    def set_accepting(self, accepting: bool) -> None:
+        with self._lock:
+            self._accepting = bool(accepting)
+
+    def begin_drain(self) -> None:
+        """Stop admitting; everything already queued still gets served
+        (or expires).  Irreversible — drain is the path to exit."""
+        with self._lock:
+            self._draining = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def idle(self) -> bool:
+        """Atomically: nothing queued AND nothing popped-but-unresponded.
+        The drain-complete condition."""
+        with self._lock:
+            return not self._items and self._inflight == 0
+
+    def batch_done(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    # -- service-time feedback (engine) ----------------------------------
+
+    def note_batch_service(self, seconds: float) -> None:
+        """EMA update from the engine after each dispatched batch; also
+        seeded once by warm-up so the very first estimates aren't blind."""
+        seconds = float(seconds)
+        with self._lock:
+            self._service_ema = (
+                seconds
+                if self._service_ema is None
+                else self._alpha * seconds + (1 - self._alpha) * self._service_ema
+            )
+
+    def estimated_delay(self) -> float:
+        """Seconds a request admitted NOW is expected to wait before its
+        batch completes: queued batches ahead of it plus its own batch's
+        service time.  0.0 until the engine has calibrated."""
+        with self._lock:
+            return self._estimated_delay_locked(extra=1)
+
+    def _estimated_delay_locked(self, extra: int = 1) -> float:
+        if self._service_ema is None:
+            return 0.0
+        batches_ahead = (len(self._items) + extra + self.batch_capacity - 1) \
+            // self.batch_capacity
+        return batches_ahead * self._service_ema
+
+    # -- admission -------------------------------------------------------
+
+    def _count_shed(self, reason: str) -> None:
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+
+    def note_terminal_reason(self, reason: str) -> None:
+        """Public shed/expiry accounting hook for the engine (e.g.
+        ``expired-at-response`` is decided at dispatch, not here)."""
+        with self._lock:
+            self._count_shed(reason)
+
+    def admit(self, req: "rq.ServeRequest") -> bool:
+        """Admit or immediately resolve ``req`` with a named shed/expiry
+        reason.  Returns True iff the request entered the queue."""
+        with self._lock:
+            if self._draining:
+                reason = rq.SHED_DRAINING
+            elif not self._accepting:
+                reason = rq.SHED_NOT_READY
+            elif self.max_len and len(req) > self.max_len:
+                reason = rq.SHED_TOO_LONG
+            elif req.deadline.exceeded():
+                reason = rq.EXPIRED_AT_ADMISSION
+            elif len(self._items) >= self.capacity:
+                reason = rq.SHED_QUEUE_FULL
+            elif req.deadline.remaining() < self._estimated_delay_locked():
+                reason = rq.SHED_DEADLINE_UNMEETABLE
+            else:
+                self._items.append(req)
+                self.admitted += 1
+                self._cond.notify()
+                return True
+            self._count_shed(reason)
+            count = self.shed_counts[reason]
+            depth, est = len(self._items), self._estimated_delay_locked()
+        # resolve OUTSIDE the lock: respond() wakes transport waiters
+        if reason == rq.EXPIRED_AT_ADMISSION:
+            req.expire(reason)
+        else:
+            req.shed(reason)
+        # a flood sheds thousands of times in seconds; log the first few
+        # per reason then sample — the per-reason counters in /stats stay
+        # exact either way
+        if count <= 5 or count % 100 == 0:
+            logger.warning(
+                f"SHED request {req.request_id}: {reason} #{count} "
+                f"(depth {depth}/{self.capacity}, est-delay {est:.3f}s, "
+                f"deadline-left {req.deadline.remaining():.3f}s)"
+            )
+        return False
+
+    # -- batch formation -------------------------------------------------
+
+    def take_batch(
+        self,
+        bucket_edges: Optional[Sequence[int]],
+        timeout: float,
+        *,
+        max_len: int,
+        clock=time.monotonic,
+    ) -> Optional[Tuple[List["rq.ServeRequest"], int]]:
+        """Form the next bucket-affine batch, waiting up to ``timeout``
+        seconds for work.  Returns ``(requests, padded_len)`` or None.
+
+        Expired requests encountered while forming are dropped and
+        resolved ``expired-in-queue`` — their compute is never spent.
+        The condition wait is sliced under ``timeout`` (never unbounded),
+        so the engine loop stays responsive to drain/stop.
+        """
+        deadline = clock() + max(0.0, float(timeout))
+        expired: List[rq.ServeRequest] = []
+        picked: List[rq.ServeRequest] = []
+        padded = 0
+        with self._lock:
+            while True:
+                # shed expired heads first so a queue full of corpses
+                # doesn't stall live work behind them
+                head = None
+                while self._items:
+                    cand = self._items.popleft()
+                    if cand.deadline.exceeded():
+                        expired.append(cand)
+                        continue
+                    head = cand
+                    break
+                if head is not None:
+                    break
+                left = deadline - clock()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=min(0.05, left))
+            if head is not None:
+                padded = bucket_for(len(head), bucket_edges) or min(
+                    max(len(head), 1), max_len
+                )
+                picked.append(head)
+                # FIFO scan for same-bucket peers; non-matching requests
+                # keep their positions
+                keep: List[rq.ServeRequest] = []
+                while self._items and len(picked) < self.batch_capacity:
+                    cand = self._items.popleft()
+                    if cand.deadline.exceeded():
+                        expired.append(cand)
+                        continue
+                    cand_bucket = bucket_for(len(cand), bucket_edges) or min(
+                        max(len(cand), 1), max_len
+                    )
+                    if cand_bucket == padded:
+                        picked.append(cand)
+                    else:
+                        keep.append(cand)
+                for item in reversed(keep):
+                    self._items.appendleft(item)
+            if picked:
+                # same lock as the pop: an observer can never see the
+                # queue empty while these requests are un-responded
+                self._inflight += 1
+            for corpse in expired:
+                self._count_shed(rq.EXPIRED_IN_QUEUE)
+        for corpse in expired:
+            corpse.expire(rq.EXPIRED_IN_QUEUE)
+            logger.warning(
+                f"EXPIRED request {corpse.request_id} dropped while forming "
+                "a batch (expired-in-queue): its deadline ran out waiting — "
+                "not computed"
+            )
+        if not picked:
+            return None
+        return picked, int(padded)

@@ -1,0 +1,445 @@
+"""Continuous micro-batching inference engine.
+
+One loop, four stages: **admit** (the bounded :class:`AdmissionQueue`
+sheds overload at the door) → **batch** (bucket-affine formation, expired
+requests dropped un-computed) → **dispatch** (ONE jitted XLA program per
+shape bucket — the same bounded-geometry discipline the trainer earned
+with ``--length-bucket`` + the persistent compile cache) → **respond**
+(deadline checked one last time).
+
+Robustness invariants, in order of importance:
+
+* **Bounded warm-up**: every bucket's program is compiled at startup
+  (``warmup()``); readiness flips true only after.  Steady state compiles
+  NOTHING — a post-warm-up recompile is a geometry leak and logs a loud
+  WARNING with the program count, exactly like the trainer's
+  ``--compile-warmup-updates`` watchdog.
+* **Bounded waits**: every blocking wait in this package is sliced and
+  deadline-bounded (lint rule ``unbounded-serve-wait``).
+* **Swap on a batch boundary**: hot reload hands a verified+probed
+  variables tree to :meth:`request_swap`; the loop applies it BETWEEN
+  batches, so no batch ever computes against half-swapped weights.
+* **Drain, don't drop**: SIGTERM stops admission and flushes in-flight
+  work under a deadline (:meth:`drain`); only the deadline expiring
+  abandons the remainder (each abandoned request still gets a named
+  response).
+"""
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from unicore_tpu.checkpoint.emergency import Deadline
+from unicore_tpu.distributed import chaos
+from unicore_tpu.serve import request as rq
+from unicore_tpu.serve.admission import AdmissionQueue
+from unicore_tpu.utils import retry
+
+logger = logging.getLogger(__name__)
+
+#: engine phases surfaced by the readiness probe
+PHASE_WARMING = "warming-up"
+PHASE_SERVING = "serving"
+PHASE_RELOADING = "reloading"
+PHASE_DRAINING = "draining"
+PHASE_STOPPED = "stopped"
+
+
+def build_infer_fn(model) -> Tuple[Callable, Callable[[], int]]:
+    """The jitted serving step for a ``src_tokens``-shaped model (the
+    bert family): ``(variables, tokens[B, L]) -> (ids[B, L] int32,
+    score[B] float32)``.
+
+    ``score`` is the mean best-logit per row — a cheap confidence proxy
+    AND the hot-reload probe's NaN canary: poisoned weights that still
+    produce well-shaped int ids cannot hide from a float statistic.
+
+    Returns ``(infer_fn, cache_size_probe)``; the probe counts compiled
+    executables (same private-API discipline as the trainer's recompile
+    watchdog — a jax rename disables the gauge with a warning, never
+    crashes serving).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _infer(variables, src_tokens):
+        logits = model.apply(variables, src_tokens, train=False)
+        ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        score = jnp.max(logits.astype(jnp.float32), axis=-1).mean(axis=-1)
+        return ids, score
+
+    warned = [False]
+
+    def cache_size() -> int:
+        try:
+            return int(_infer._cache_size())
+        except Exception:
+            if not warned[0]:
+                warned[0] = True
+                logger.warning(
+                    "jit _cache_size() probe failed (jax version change?): "
+                    "the serve recompile-after-warmup warning is disabled"
+                )
+            return -1
+
+    return _infer, cache_size
+
+
+class ServeEngine:
+    """Owns the serving snapshot (model variables), the per-bucket jitted
+    programs, and the admit→batch→dispatch→respond loop."""
+
+    def __init__(
+        self,
+        variables,
+        infer_fn: Callable,
+        *,
+        bucket_edges: Sequence[int],
+        batch_size: int,
+        pad_idx: int = 0,
+        queue: Optional[AdmissionQueue] = None,
+        admission_capacity: int = 256,
+        cache_size_probe: Optional[Callable[[], int]] = None,
+        latency_window: int = 2048,
+    ):
+        if not bucket_edges:
+            raise ValueError("bucket_edges must name at least one length")
+        self.variables = variables
+        self.infer_fn = infer_fn
+        self.bucket_edges = tuple(sorted(int(e) for e in bucket_edges))
+        self.batch_size = max(1, int(batch_size))
+        self.pad_idx = int(pad_idx)
+        self.queue = queue or AdmissionQueue(
+            admission_capacity,
+            batch_capacity=self.batch_size,
+            max_len=self.bucket_edges[-1],
+        )
+        self._cache_size_probe = cache_size_probe
+        self._warm_programs = 0
+        self.recompiles_after_warmup = 0
+        self._phase = PHASE_WARMING
+        self._ready = False
+        self._stop = threading.Event()
+        self._batch_seq = 0
+        self.served = 0
+        self.expired_at_response = 0
+        self._latencies_ms: List[float] = []
+        self._latency_window = int(latency_window)
+        self._lock = threading.Lock()
+        # hot-reload handoff: (variables, tag) applied on a batch boundary
+        self._pending_swap = None
+        self._swap_tag = None
+        self.reloads_applied = 0
+        self._thread: Optional[threading.Thread] = None
+        #: the exception that killed the loop thread, if any — the CLI
+        #: polls this: a server whose engine died must exit for its
+        #: supervisor, never linger as a zombie with liveness green
+        self.fatal_error: Optional[BaseException] = None
+
+    # -- probes ----------------------------------------------------------
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    def ready(self) -> bool:
+        return self._ready
+
+    def set_ready(self, ready: bool, phase: Optional[str] = None) -> None:
+        # draining/stopped are terminal: a hot reload that raced SIGTERM
+        # must not flip readiness back on and have a load balancer route
+        # traffic at a server that sheds everything
+        if self._phase in (PHASE_DRAINING, PHASE_STOPPED):
+            return
+        self._ready = bool(ready)
+        if phase is not None:
+            self._phase = phase
+
+    # -- warm-up ---------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Compile (or reload from the persistent cache) every bucket's
+        program before the first real request; flips readiness true.
+        Returns the number of programs compiled — the acceptance bound is
+        ``<= len(bucket_edges)``."""
+        self._phase = PHASE_WARMING
+        self._ready = False
+        t0 = time.monotonic()
+        for edge in self.bucket_edges:
+            dummy = np.full(
+                (self.batch_size, edge), self.pad_idx, dtype=np.int32
+            )
+            _block_on(self.infer_fn(self.variables, dummy))  # compiles
+            # seed the admission queue's service estimate from a SECOND,
+            # warm dispatch: timing the compiling one would inflate the
+            # estimated queue delay by seconds and falsely shed the first
+            # real requests as deadline-unmeetable
+            tb0 = time.monotonic()
+            _block_on(self.infer_fn(self.variables, dummy))
+            self.queue.note_batch_service(time.monotonic() - tb0)
+        if self._cache_size_probe is not None:
+            self._warm_programs = self._cache_size_probe()
+        programs = max(self._warm_programs, 0) or len(self.bucket_edges)
+        logger.info(
+            f"serve warm-up complete: {programs} program(s) for "
+            f"{len(self.bucket_edges)} bucket(s) "
+            f"{list(self.bucket_edges)} x batch {self.batch_size} in "
+            f"{time.monotonic() - t0:.1f}s; readiness -> true"
+        )
+        self._phase = PHASE_SERVING
+        self._ready = True
+        self.queue.set_accepting(True)
+        return programs
+
+    def _watch_recompiles(self) -> None:
+        if self._cache_size_probe is None or self._warm_programs <= 0:
+            return
+        n = self._cache_size_probe()
+        if n > self._warm_programs:
+            grew = n - self._warm_programs
+            self._warm_programs = n
+            self.recompiles_after_warmup += grew
+            logger.warning(
+                f"recompile after warmup: {grew} new serve program(s) "
+                f"compiled at batch {self._batch_seq} ({n} total).  A "
+                "request geometry escaped the bucket set — this should be "
+                "impossible (admission sheds over-long requests); check "
+                "bucket_edges vs the transport's validation."
+            )
+
+    # -- submission (transports + flood generator + bench) ---------------
+
+    def submit(self, tokens, deadline_s: float,
+               request_id: Optional[str] = None) -> rq.ServeRequest:
+        """Admit one request (or resolve it immediately with a named
+        reason).  The caller waits on the returned request's completion
+        via ``retry.bounded_wait``."""
+        req = rq.ServeRequest.make(tokens, deadline_s, request_id)
+        self.queue.admit(req)
+        return req
+
+    # -- hot reload ------------------------------------------------------
+
+    def probe(self, variables) -> None:
+        """Run one dummy batch through the SAME warmed program with
+        candidate ``variables``; raises if the output is ill-shaped or
+        the score canary is non-finite.  Shapes match warm-up, so a probe
+        can never compile a new program."""
+        edge = self.bucket_edges[0]
+        dummy = np.full((self.batch_size, edge), self.pad_idx, dtype=np.int32)
+        ids, score = self.infer_fn(variables, dummy)
+        ids, score = np.asarray(ids), np.asarray(score)
+        if ids.shape != (self.batch_size, edge):
+            raise ValueError(
+                f"probe batch produced shape {ids.shape}, "
+                f"expected {(self.batch_size, edge)}"
+            )
+        if not np.all(np.isfinite(score)):
+            raise ValueError(
+                "probe batch produced non-finite scores (poisoned weights?)"
+            )
+
+    def request_swap(self, variables, tag: str) -> None:
+        """Hand a verified+probed variables tree to the loop; it is
+        applied on the next batch boundary (never mid-batch)."""
+        with self._lock:
+            self._pending_swap = variables
+            self._swap_tag = tag
+
+    def _apply_pending_swap(self) -> None:
+        with self._lock:
+            pending, tag = self._pending_swap, self._swap_tag
+            self._pending_swap = self._swap_tag = None
+        if pending is None:
+            return
+        self.variables = pending
+        self.reloads_applied += 1
+        logger.warning(
+            f"RELOAD SWAPPED: serving snapshot replaced on batch boundary "
+            f"{self._batch_seq} ({tag})"
+        )
+
+    # -- the loop --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name="serve-engine", daemon=True
+        )
+        self._thread.start()
+
+    def run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._apply_pending_swap()
+                self.step(timeout=0.05)
+        except Exception as err:
+            logger.exception("serve engine loop died")
+            self.fatal_error = err
+            self._ready = False
+            self._phase = PHASE_STOPPED
+            raise
+
+    def healthy(self) -> bool:
+        """False once the loop thread has died (or recorded a fatal) —
+        distinct from liveness: the process is up, but nothing will ever
+        serve another request."""
+        if self.fatal_error is not None:
+            return False
+        return self._thread is None or self._thread.is_alive()
+
+    def step(self, timeout: float = 0.05) -> int:
+        """One loop iteration: form and dispatch at most one batch.
+        Returns the number of requests served (0 if no work arrived
+        within ``timeout``)."""
+        batch = self.queue.take_batch(
+            self.bucket_edges, timeout, max_len=self.bucket_edges[-1]
+        )
+        chaos.note_serve_batch(self._batch_seq)
+        if batch is None:
+            return 0
+        reqs, padded = batch
+        # the queue counted this batch in-flight at pop time (same lock),
+        # so drain's "queue idle" observation can never race the span
+        # between pop and the responses below; batch_done closes it
+        try:
+            t0 = time.monotonic()
+            arr = np.full(
+                (self.batch_size, padded), self.pad_idx, dtype=np.int32
+            )
+            for i, r in enumerate(reqs):
+                arr[i, : len(r)] = r.tokens
+            ids, score = self.infer_fn(self.variables, arr)
+            ids, score = np.asarray(ids), np.asarray(score)
+            service = time.monotonic() - t0
+            self.queue.note_batch_service(service)
+            self._batch_seq += 1
+            for i, r in enumerate(reqs):
+                if r.deadline.exceeded():
+                    # computed but useless: the deadline ran out during
+                    # dispatch — count it honestly, never pretend success
+                    self.expired_at_response += 1
+                    self.queue.note_terminal_reason(rq.EXPIRED_AT_RESPONSE)
+                    r.expire(rq.EXPIRED_AT_RESPONSE)
+                    continue
+                latency_ms = (time.monotonic() - r.arrival) * 1000.0
+                r.respond(
+                    rq.ServeResponse(
+                        r.request_id,
+                        rq.STATUS_OK,
+                        output=[int(t) for t in ids[i, : len(r)]],
+                        score=float(score[i]),
+                        latency_ms=latency_ms,
+                        bucket=padded,
+                    )
+                )
+                self.served += 1
+                with self._lock:
+                    self._latencies_ms.append(latency_ms)
+                    if len(self._latencies_ms) > self._latency_window:
+                        del self._latencies_ms[: self._latency_window // 4]
+            self._watch_recompiles()
+            return len(reqs)
+        finally:
+            self.queue.batch_done()
+
+    # -- drain / stop ----------------------------------------------------
+
+    def drain(self, deadline: Deadline) -> bool:
+        """Graceful shutdown: stop admitting, flush everything already
+        queued (plus the in-flight batch) under ``deadline``.  Returns
+        True when the queue emptied in time; False means the budget ran
+        out and the leftovers were resolved with named reasons."""
+        self.queue.begin_drain()
+        self.set_ready(False, PHASE_DRAINING)
+        depth = self.queue.depth()
+        logger.info(
+            f"DRAIN started: {depth} queued request(s), "
+            f"budget {deadline.budget if deadline.budget is not None else 'inf'}s"
+        )
+        try:
+            retry.bounded_wait(
+                self.queue.idle,
+                timeout=max(0.0, deadline.remaining()),
+                poll_s=0.05,
+                describe="serve drain",
+            )
+            drained = True
+        except retry.WaitTimeoutError:
+            drained = False
+        self.stop()
+        if drained:
+            logger.info(
+                f"DRAIN complete: in-flight work flushed in "
+                f"{deadline.elapsed():.2f}s"
+            )
+        else:
+            leftovers = self._flush_undrained()
+            logger.error(
+                f"DRAIN deadline exceeded: {leftovers} request(s) abandoned "
+                f"after {deadline.elapsed():.2f}s (each got a terminal "
+                "'draining' response)"
+            )
+        return drained
+
+    def _flush_undrained(self) -> int:
+        n = 0
+        while True:
+            batch = self.queue.take_batch(
+                self.bucket_edges, 0.0, max_len=self.bucket_edges[-1]
+            )
+            if batch is None:
+                break
+            for r in batch[0]:
+                r.shed(rq.SHED_DRAINING)
+                n += 1
+            self.queue.batch_done()
+        return n
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._phase = PHASE_STOPPED
+        self._ready = False
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # -- stats -----------------------------------------------------------
+
+    def latency_percentiles(self) -> dict:
+        with self._lock:
+            lat = list(self._latencies_ms)
+        if not lat:
+            return {}
+        arr = np.asarray(lat)
+        return {
+            f"p{p}_ms": round(float(np.percentile(arr, p)), 3)
+            for p in (50, 90, 99)
+        }
+
+    def stats(self) -> dict:
+        return {
+            "phase": self._phase,
+            "ready": self._ready,
+            "served": self.served,
+            "admitted": self.queue.admitted,
+            "shed": dict(self.queue.shed_counts),
+            "depth": self.queue.depth(),
+            "batches": self._batch_seq,
+            "buckets": list(self.bucket_edges),
+            "batch_size": self.batch_size,
+            "estimated_delay_s": round(self.queue.estimated_delay(), 4),
+            "recompiles_after_warmup": self.recompiles_after_warmup,
+            "reloads_applied": self.reloads_applied,
+            **self.latency_percentiles(),
+        }
+
+
+def _block_on(out) -> None:
+    """Wait for a dispatched device computation without importing jax in
+    the fake-infer test path."""
+    for leaf in out if isinstance(out, (tuple, list)) else (out,):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()

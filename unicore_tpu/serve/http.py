@@ -1,0 +1,270 @@
+"""HTTP transport: liveness/readiness probes + the inference endpoint.
+
+Thin by design — every serving decision (shed, deadline, batching) lives
+in the engine/admission layer; this module only maps outcomes onto HTTP:
+
+* ``GET /healthz``  → 200 while the process lives (liveness);
+* ``GET /readyz``   → 200 only when the engine is warmed and neither
+  reloading nor draining (readiness — what a load balancer routes on);
+* ``GET /stats``    → JSON counters + latency percentiles;
+* ``POST /v1/infer`` → ``{"tokens": [...], "deadline_ms": N, "id": "..."}``
+  → 200 ok / 429 shed (named reason) / 503 not-ready-or-draining /
+  504 expired / 408 slow client.
+
+Transport robustness: the body read is deadline-bounded (a client that
+trickles its request — chaos ``slow-client`` — gets a 408 instead of
+wedging a worker thread), the response wait goes through
+``utils/retry.bounded_wait``, and each connection carries a socket
+timeout as the OS-level backstop.
+"""
+
+import json
+import logging
+import socket
+import threading
+import time
+
+import numpy as np
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from unicore_tpu.distributed import chaos
+from unicore_tpu.serve import request as rq
+from unicore_tpu.utils import retry
+
+logger = logging.getLogger(__name__)
+
+#: status → HTTP code; shed reasons that mean "try another replica" map
+#: to 503 so load balancers retry elsewhere, capacity sheds map to 429
+_SHED_CODES = {
+    rq.SHED_QUEUE_FULL: 429,
+    rq.SHED_DEADLINE_UNMEETABLE: 429,
+    rq.SHED_TOO_LONG: 400,
+    rq.SHED_DRAINING: 503,
+    rq.SHED_NOT_READY: 503,
+}
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # drain fast on close: don't linger on half-open keep-alives
+    allow_reuse_address = True
+
+    def __init__(self, addr, engine, *, read_timeout_s: float = 10.0,
+                 max_body_bytes: int = 1 << 20,
+                 default_deadline_ms: float = 1000.0,
+                 max_deadline_ms: float = 60000.0):
+        self.engine = engine
+        self.read_timeout_s = float(read_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.default_deadline_ms = float(default_deadline_ms)
+        self.max_deadline_ms = float(max_deadline_ms)
+        super().__init__(addr, ServeHandler)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self.serve_forever, name="serve-http", daemon=True
+        )
+        t.start()
+        return t
+
+
+class SlowClientError(RuntimeError):
+    """The request body did not arrive within the read budget."""
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def setup(self):
+        super().setup()
+        # OS-level backstop under the explicit read deadline below: a
+        # genuinely stalled socket raises timeout out of rfile.read
+        self.connection.settimeout(self.server.read_timeout_s)
+
+    # stdlib logs one stderr line per request; at flood QPS that IS the
+    # bottleneck — route to debug
+    def log_message(self, format, *args):
+        logger.debug("http: " + format % args)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- probes ----------------------------------------------------------
+
+    def do_GET(self):
+        engine = self.server.engine
+        if self.path == "/healthz":
+            self._send_json(200, {"live": True, "phase": engine.phase})
+        elif self.path == "/readyz":
+            ready = engine.ready()
+            self._send_json(
+                200 if ready else 503,
+                {"ready": ready, "phase": engine.phase},
+            )
+        elif self.path == "/stats":
+            self._send_json(200, engine.stats())
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    # -- inference -------------------------------------------------------
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            self.close_connection = True  # nothing consumed: don't reuse
+            raise ValueError("missing/empty body (Content-Length required)")
+        if length > self.server.max_body_bytes:
+            self.close_connection = True  # body left unread on the stream
+            raise ValueError(
+                f"body of {length} bytes exceeds the "
+                f"{self.server.max_body_bytes}-byte limit"
+            )
+        # chaos 'slow-client': the bytes "arrive" only after the injected
+        # stall — the bounded wait below must 408 a stall longer than the
+        # read budget instead of blocking a worker for the duration
+        stall = chaos.take_slow_client_delay()
+        if stall > 0:
+            arrive_at = time.monotonic() + stall
+            try:
+                retry.bounded_wait(
+                    lambda: time.monotonic() >= arrive_at,
+                    timeout=self.server.read_timeout_s,
+                    poll_s=0.05,
+                    describe="request body read (slow client)",
+                )
+            except retry.WaitTimeoutError as err:
+                raise SlowClientError(str(err)) from None
+        # ONE deadline for the whole body, enforced across chunked read1
+        # calls (at most one recv each): the per-recv socket timeout alone
+        # would reset on every trickled byte, letting a slow-loris client
+        # hold this worker for hours while never tripping it
+        deadline = time.monotonic() + self.server.read_timeout_s
+        buf = bytearray()
+        try:
+            while len(buf) < length:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise SlowClientError(
+                        f"body incomplete ({len(buf)}/{length} bytes) after "
+                        f"{self.server.read_timeout_s:g}s"
+                    )
+                self.connection.settimeout(min(left, self.server.read_timeout_s))
+                chunk = self.rfile.read1(length - len(buf))
+                if not chunk:
+                    raise ValueError(
+                        f"client closed mid-body ({len(buf)}/{length} bytes)"
+                    )
+                buf.extend(chunk)
+        except socket.timeout as err:
+            raise SlowClientError(
+                f"socket read timed out after "
+                f"{self.server.read_timeout_s:g}s"
+            ) from err
+        finally:
+            self.connection.settimeout(self.server.read_timeout_s)
+        return bytes(buf)
+
+    def do_POST(self):
+        if self.path != "/v1/infer":
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+            return
+        server = self.server
+        try:
+            body = self._read_body()
+            payload = json.loads(body.decode("utf-8"))
+            tokens = payload["tokens"]
+            if not isinstance(tokens, list) or not tokens:
+                raise ValueError("'tokens' must be a non-empty list of ids")
+            # validate HERE, not in the engine: a string, a ragged nest,
+            # or an id past int32 must be a named 400, never a handler
+            # traceback with no HTTP response at all
+            try:
+                tokens = np.asarray(tokens, dtype=np.int32)
+            except (TypeError, ValueError, OverflowError) as err:
+                raise ValueError(
+                    f"'tokens' must be a flat list of int32 ids ({err})"
+                ) from None
+            if tokens.ndim != 1:
+                raise ValueError("'tokens' must be a FLAT list of ids")
+            # explicit None check, not truthiness: a client-sent deadline
+            # of 0 means "already expired" (Deadline's own contract), not
+            # "use the default" — and a non-numeric value is a named 400
+            # like every other malformed field, never a traceback
+            raw_deadline = payload.get("deadline_ms")
+            try:
+                deadline_ms = min(
+                    float(
+                        server.default_deadline_ms
+                        if raw_deadline is None
+                        else raw_deadline
+                    ),
+                    server.max_deadline_ms,
+                )
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"'deadline_ms' must be a number, got {raw_deadline!r}"
+                ) from None
+        except SlowClientError as err:
+            # the body was never fully consumed: leftover bytes on the
+            # keep-alive stream would be parsed as the NEXT request line,
+            # desyncing the connection — close it with the 408
+            self.close_connection = True
+            logger.warning(f"SHED request: slow-client ({err})")
+            self._send_json(
+                408, {"status": rq.STATUS_SHED, "reason": "slow-client"}
+            )
+            return
+        except (ValueError, KeyError, json.JSONDecodeError) as err:
+            self._send_json(400, {"status": "error", "reason": str(err)})
+            return
+        req = server.engine.submit(
+            tokens, deadline_ms / 1000.0, payload.get("id")
+        )
+        try:
+            # the engine resolves every admitted request by its deadline
+            # (expired-at-*), so the grace only covers scheduling slop
+            retry.bounded_wait(
+                req.done,
+                timeout=deadline_ms / 1000.0 + 2.0,
+                poll_s=0.01,
+                describe=f"response for {req.request_id}",
+            )
+        except retry.WaitTimeoutError:
+            self._send_json(
+                504,
+                {
+                    "id": req.request_id,
+                    "status": rq.STATUS_EXPIRED,
+                    "reason": "response-timeout",
+                },
+            )
+            return
+        resp = req.response
+        if resp.status == rq.STATUS_OK:
+            code = 200
+        elif resp.status == rq.STATUS_EXPIRED:
+            code = 504
+        elif resp.status == rq.STATUS_SHED:
+            code = _SHED_CODES.get(resp.reason, 429)
+        else:
+            code = 500
+        self._send_json(code, resp.to_json())
+
+
+def bind_server(host: str, port: int, engine, **kw) -> ServeHTTPServer:
+    """Bind (raises OSError on an unbindable host/port — the CLI maps it
+    to exit 75).  ``port=0`` picks an ephemeral port; the bound address
+    is logged either way so operators and smokes can find it."""
+    server = ServeHTTPServer((host, port), engine, **kw)
+    logger.info(
+        f"SERVE listening on http://{server.server_address[0]}:"
+        f"{server.server_address[1]} "
+        "(/healthz /readyz /stats /v1/infer)"
+    )
+    return server

@@ -40,6 +40,13 @@ class KVTimeoutError(TimeoutError):
     the coordination service stayed unreachable past the budget)."""
 
 
+class WaitTimeoutError(TimeoutError):
+    """A deadline-bounded local wait (queue, event, socket drain) expired.
+    Raised by :func:`bounded_wait` — the serving plane's equivalent of
+    :class:`KVTimeoutError`: a slow client or a wedged consumer surfaces
+    as a diagnosable timeout, never an unbounded block."""
+
+
 @dataclasses.dataclass
 class RetryPolicy:
     """Exponential backoff + jitter + deadline, shared by checkpointing,
@@ -198,6 +205,46 @@ def kv_wait(
             if _looks_like_kv_timeout(err):
                 continue
             raise
+
+
+def bounded_wait(
+    predicate: Callable[[], bool],
+    timeout: float,
+    *,
+    poll_s: float = 0.05,
+    should_abort: Optional[Callable[[], None]] = None,
+    describe: str = "",
+    clock: Optional[Callable[[], float]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+) -> None:
+    """Deadline-bounded local wait: poll ``predicate`` in ``poll_s``
+    slices until it returns True, raising :class:`WaitTimeoutError` once
+    ``timeout`` seconds have passed.
+
+    This is the one sanctioned shape for every blocking wait inside the
+    serving plane (``unicore_tpu/serve/`` — lint rule
+    ``unbounded-serve-wait``): a request handler waiting on its response
+    event, the engine waiting for work, the drain loop waiting for
+    in-flight batches.  ``should_abort`` is invoked between slices and may
+    raise to abandon the wait early (a handler observing server
+    shutdown).  Like :func:`kv_wait`, short slices are the point — the
+    waiter stays responsive to shutdown instead of sleeping out the whole
+    budget."""
+    clock = time.monotonic if clock is None else clock
+    sleep = time.sleep if sleep is None else sleep
+    deadline = clock() + max(0.0, float(timeout))
+    while True:
+        if should_abort is not None:
+            should_abort()
+        if predicate():
+            return
+        left = deadline - clock()
+        if left <= 0:
+            raise WaitTimeoutError(
+                f"condition not met after {timeout:.3f}s"
+                + (f" ({describe})" if describe else "")
+            )
+        sleep(min(poll_s, left))
 
 
 def kv_fetch(client, key: str, *, poll_ms: int = 100):

@@ -1,0 +1,319 @@
+#!/usr/bin/env python3
+"""``unicore-tpu-serve``: the serving-plane entry point.
+
+Boot sequence (each stage has a documented failure exit code — external
+supervisors restart on these without log-grepping, same discipline as
+the training taxonomy 65-74 in docs/robustness.md):
+
+1. verified model load from ``--path`` (exit **76** on failure: missing
+   file, corrupt checkpoint rejected by the integrity manifest, config
+   that can't rebuild the model, or a warm-up that can't compile);
+2. HTTP bind on ``--host:--port`` (exit **75** on failure) — probes go
+   live immediately, readiness stays false;
+3. bucket warm-up: one XLA program per bucket compiled (or reloaded from
+   ``--jax-compilation-cache-dir``); readiness flips true only after;
+4. serve until signalled: SIGTERM/SIGINT triggers a graceful drain —
+   admission stops, in-flight batches flush under ``--drain-deadline``,
+   exit **0**; a blown drain budget exits **77**; a second signal aborts
+   immediately (also 77 — the drain did not complete cleanly).
+
+``--reload-interval`` arms hot checkpoint reload (verify-then-swap with
+rollback); ``--fault-inject`` arms the serving chaos kinds.  See
+docs/serving.md.
+"""
+
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+_LOG_FIELDS = ("asctime", "levelname", "name", "message")
+logging.basicConfig(
+    stream=sys.stdout,
+    level=os.environ.get("LOGLEVEL", "INFO").upper(),
+    format=" | ".join(f"%({f})s" for f in _LOG_FIELDS),
+    datefmt="%Y-%m-%d %H:%M:%S",
+)
+logger = logging.getLogger("unicore_tpu_cli.serve")
+
+# serving exit-code taxonomy (documented in docs/robustness.md alongside
+# the training codes 65-74)
+EXIT_OK = 0
+EXIT_SERVE_BIND = 75            # HTTP bind/port failure at startup
+EXIT_SERVE_MODEL_LOAD = 76      # model load / warm-up failure at startup
+EXIT_SERVE_DRAIN_DEADLINE = 77  # drain budget exceeded (or forced abort)
+
+SERVE_EXIT_CODE_NAMES = {
+    EXIT_OK: "ok",
+    EXIT_SERVE_BIND: "serve-bind-failure",
+    EXIT_SERVE_MODEL_LOAD: "serve-model-load-failure",
+    EXIT_SERVE_DRAIN_DEADLINE: "serve-drain-deadline-exceeded",
+}
+
+# signal plumbing: first signal requests a drain, the second aborts
+_drain_requested = threading.Event()
+_signal_count = 0
+
+
+def _handle_signal(signum, frame):
+    global _signal_count
+    _signal_count += 1
+    name = signal.Signals(signum).name
+    if _signal_count == 1:
+        logger.warning(
+            f"received {name}: graceful drain — admission stops, in-flight "
+            "batches flush under --drain-deadline (second signal aborts)"
+        )
+        _drain_requested.set()
+    else:
+        logger.error(f"received second {name}: aborting without drain")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_SERVE_DRAIN_DEADLINE)
+
+
+def load_serving_model(args):
+    """Verified checkpoint load + model/task rebuild from the saved args.
+    Any failure here is exit 76 territory — there is nothing to serve."""
+    from unicore_tpu import checkpoint_utils, tasks
+
+    state = checkpoint_utils.load_checkpoint_to_cpu(args.path)
+    ckpt_args = state.get("args")
+    if ckpt_args is None:
+        raise ValueError(
+            f"checkpoint {args.path} carries no saved args; cannot rebuild "
+            "the model (was it written by an external tool?)"
+        )
+    if args.data:
+        ckpt_args.data = args.data
+    variables = state.get("model")
+    if variables is None:
+        raise ValueError(f"checkpoint {args.path} holds no model tree")
+    task = tasks.setup_task(ckpt_args)
+    model = task.build_model(ckpt_args)
+    pad_idx = (
+        task.dictionary.pad()
+        if getattr(task, "dictionary", None) is not None
+        else 0
+    )
+    max_seq_len = int(getattr(ckpt_args, "max_seq_len", 512) or 512)
+    hist = state.get("optimizer_history") or []
+    step = hist[-1].get("num_updates", "?") if hist else "?"
+    logger.info(
+        f"serving model from {args.path} (step {step}, task "
+        f"{type(task).__name__}, max_seq_len {max_seq_len})"
+    )
+    return model, variables, pad_idx, max_seq_len
+
+
+def build_engine(args, model, variables, pad_idx, max_seq_len):
+    from unicore_tpu.data.data_utils import compute_length_buckets
+    from unicore_tpu.serve import ServeEngine, build_infer_fn
+
+    edges = compute_length_buckets(args.serve_buckets, max_seq_len) or (
+        max_seq_len,
+    )
+    infer_fn, cache_probe = build_infer_fn(model)
+    return ServeEngine(
+        variables,
+        infer_fn,
+        bucket_edges=edges,
+        batch_size=args.serve_batch_size,
+        pad_idx=pad_idx,
+        admission_capacity=args.admission_capacity,
+        cache_size_probe=cache_probe,
+    )
+
+
+def _start_flood_generator(args, engine, stop_event: threading.Event):
+    """Synthetic traffic driver for the ``request-flood`` chaos kind:
+    offers chaos.serve_flood_qps() requests per second straight into
+    admission while the flood window is open.  Request lengths cycle the
+    bucket set so the flood exercises every warmed program."""
+    from unicore_tpu.distributed import chaos
+
+    def run():
+        i = 0
+        while not stop_event.is_set():
+            if not engine.ready():
+                # don't open the flood window against a warming/reloading
+                # server — the chaos proves admission control, not that a
+                # cold server sheds everything
+                stop_event.wait(timeout=0.1)
+                continue
+            qps = chaos.serve_flood_qps()
+            if qps <= 0:
+                stop_event.wait(timeout=0.1)
+                continue
+            edge = engine.bucket_edges[i % len(engine.bucket_edges)]
+            length = max(1, edge - 1)
+            engine.submit(
+                [5] * length,
+                args.default_deadline_ms / 1000.0,
+                request_id=f"flood{i}",
+            )
+            i += 1
+            stop_event.wait(timeout=1.0 / qps)
+
+    t = threading.Thread(target=run, name="serve-flood", daemon=True)
+    t.start()
+    return t
+
+
+def main(args) -> int:
+    import jax  # noqa: F401  (backend init before any engine work)
+
+    from unicore_tpu.checkpoint.emergency import Deadline, deadline_scope
+    from unicore_tpu.distributed import chaos
+    from unicore_tpu.serve.http import bind_server
+
+    if getattr(args, "jax_compilation_cache_dir", None):
+        jax.config.update(
+            "jax_compilation_cache_dir", args.jax_compilation_cache_dir
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    chaos.configure(args)
+    logger.info(args)
+
+    # 1. verified model load -------------------------------------------------
+    try:
+        model, variables, pad_idx, max_seq_len = load_serving_model(args)
+        engine = build_engine(args, model, variables, pad_idx, max_seq_len)
+    except Exception as err:
+        logger.error(
+            f"FATAL: model load failed ({type(err).__name__}: {err}) — "
+            f"exiting {EXIT_SERVE_MODEL_LOAD} "
+            f"({SERVE_EXIT_CODE_NAMES[EXIT_SERVE_MODEL_LOAD]})",
+            exc_info=True,
+        )
+        return EXIT_SERVE_MODEL_LOAD
+
+    # 2. bind (probes live, readiness false) ---------------------------------
+    try:
+        server = bind_server(
+            args.host, args.port, engine,
+            read_timeout_s=args.request_read_timeout,
+            default_deadline_ms=args.default_deadline_ms,
+            max_deadline_ms=args.max_deadline_ms,
+        )
+    except OSError as err:
+        logger.error(
+            f"FATAL: cannot bind {args.host}:{args.port} ({err}) — exiting "
+            f"{EXIT_SERVE_BIND} ({SERVE_EXIT_CODE_NAMES[EXIT_SERVE_BIND]})"
+        )
+        return EXIT_SERVE_BIND
+    server.start()
+
+    # 3. warm-up (readiness flips true inside) -------------------------------
+    try:
+        engine.warmup()
+    except Exception as err:
+        logger.error(
+            f"FATAL: warm-up failed ({type(err).__name__}: {err}) — exiting "
+            f"{EXIT_SERVE_MODEL_LOAD} "
+            f"({SERVE_EXIT_CODE_NAMES[EXIT_SERVE_MODEL_LOAD]})",
+            exc_info=True,
+        )
+        server.shutdown()
+        return EXIT_SERVE_MODEL_LOAD
+
+    # 4. serve ---------------------------------------------------------------
+    engine.start()
+
+    reload_runner = None
+    if args.reload_interval > 0:
+        from unicore_tpu import checkpoint_utils
+        from unicore_tpu.serve import (
+            CheckpointWatcher, HotReloader, ReloadRunner,
+        )
+
+        reload_runner = ReloadRunner(
+            CheckpointWatcher(args.path),
+            HotReloader(engine, checkpoint_utils.load_checkpoint_to_cpu),
+            args.reload_interval,
+        )
+        reload_runner.start()
+
+    flood_stop = threading.Event()
+    flood_thread = _start_flood_generator(args, engine, flood_stop)
+
+    started = time.monotonic()
+    while not _drain_requested.is_set():
+        if not engine.healthy():
+            # the engine loop died (XLA error, device loss): a process
+            # that can never serve another request must exit for its
+            # supervisor, not linger as a zombie with liveness green
+            logger.error(
+                f"FATAL: serve engine loop died "
+                f"({type(engine.fatal_error).__name__ if engine.fatal_error else 'thread exit'}: "
+                f"{engine.fatal_error}) — exiting 1"
+            )
+            flood_stop.set()
+            if reload_runner is not None:
+                reload_runner.stop()
+            server.shutdown()
+            return 1
+        if (
+            args.serve_max_seconds > 0
+            and time.monotonic() - started >= args.serve_max_seconds
+        ):
+            logger.info(
+                f"--serve-max-seconds ({args.serve_max_seconds:g}s) "
+                "reached: starting the graceful drain"
+            )
+            break
+        _drain_requested.wait(timeout=0.2)
+
+    # 5. drain ---------------------------------------------------------------
+    # reload/flood planes stop FIRST: a reload landing mid-drain would
+    # race the readiness state (the engine also refuses to resurrect a
+    # draining server — belt and suspenders), and a flood would fight the
+    # flush for the drain budget
+    flood_stop.set()
+    if reload_runner is not None:
+        reload_runner.stop()
+    deadline = Deadline(args.drain_deadline)
+    with deadline_scope(deadline):
+        drained = engine.drain(deadline)
+    server.shutdown()
+    flood_thread.join(timeout=2.0)
+    logger.info(f"final serve stats: {engine.stats()}")
+    if not drained:
+        logger.error(
+            f"exiting {EXIT_SERVE_DRAIN_DEADLINE} "
+            f"({SERVE_EXIT_CODE_NAMES[EXIT_SERVE_DRAIN_DEADLINE]})"
+        )
+        return EXIT_SERVE_DRAIN_DEADLINE
+    logger.info("serve shutdown clean: drained in-flight work, exiting 0")
+    return EXIT_OK
+
+
+def cli_main() -> None:
+    # same env contract as the training CLI: UNICORE_TPU_PLATFORM=cpu
+    # forces the virtual-CPU mesh before any jax backend init
+    from unicore_tpu.platform_utils import force_host_cpu_from_env
+
+    force_host_cpu_from_env(default_devices=1)
+
+    from unicore_tpu import options
+
+    parser = options.get_serving_parser()
+    args = parser.parse_args()
+
+    try:
+        signal.signal(signal.SIGTERM, _handle_signal)
+        signal.signal(signal.SIGINT, _handle_signal)
+    except ValueError:
+        logger.warning(
+            "could not install signal handlers (not the main thread); "
+            "graceful drain is unavailable"
+        )
+
+    sys.exit(main(args))
+
+
+if __name__ == "__main__":
+    cli_main()
