@@ -366,6 +366,35 @@ def _finish_result(result, trainer, sample, dt_per_step):
 _RUN_ID = f"{int(time.time())}-{os.getpid()}"
 
 
+def _telemetry_identity():
+    """(run_id, journal path) for this bench invocation: bench rows join
+    the same telemetry identity space as training runs and checkpoints
+    (docs/observability.md).  The journal lands beside the trace
+    artifacts; failures degrade to empty fields, never a lost row."""
+    try:
+        import argparse
+
+        from unicore_tpu import telemetry
+
+        telemetry.configure(
+            argparse.Namespace(
+                save_dir=None,
+                telemetry_dir=os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "bench_traces", "telemetry",
+                ),
+                telemetry_sample_interval=0,
+                profile_steps=None,
+            ),
+            rank=0,
+            role="bench",
+        )
+        return telemetry.run_id() or "", telemetry.journal_path() or ""
+    except Exception as e:
+        sys.stderr.write(f"bench: telemetry identity failed: {e!r}\n")
+        return "", ""
+
+
 def _append_partial(result):
     """Append the result line to BENCH_PARTIAL.jsonl immediately — a hang in
     a later config must not lose an earlier config's number.  Lines carry a
@@ -375,12 +404,26 @@ def _append_partial(result):
         line = dict(result)
         line["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
         line["run"] = _RUN_ID
+        run_id, journal = _telemetry_identity()
+        line["run_id"] = run_id
+        line["telemetry_journal"] = journal
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_PARTIAL.jsonl")
         with open(path, "a") as f:
             f.write(json.dumps(line) + "\n")
     except OSError as e:
         sys.stderr.write(f"bench: partial write failed: {e!r}\n")
+        return
+    try:  # journal mirror: same degrade-to-nothing contract as above —
+        # a telemetry failure must never lose (or abort) a bench row
+        from unicore_tpu import telemetry as _telemetry
+
+        _telemetry.emit("bench-row", **{
+            k: v for k, v in line.items()
+            if k not in ("run_id", "telemetry_journal")
+        })
+    except Exception as e:
+        sys.stderr.write(f"bench: journal mirror failed: {e!r}\n")
 
 
 def _save_trace(trainer, sample, config):

@@ -28,6 +28,7 @@ setup(
             "unicore-tpu-train = unicore_tpu_cli.train:cli_main",
             "unicore-tpu-serve = unicore_tpu_cli.serve:cli_main",
             "unicore-tpu-lint = unicore_tpu_cli.lint:main",
+            "unicore-tpu-trace = unicore_tpu_cli.trace:main",
         ],
     },
     python_requires=">=3.9",

@@ -47,6 +47,7 @@ _FAST_FILES = {
     "test_softmax_dropout.py",
     "test_fused_norm.py",
     "test_serve.py",
+    "test_telemetry.py",
 }
 
 

@@ -1154,3 +1154,134 @@ def test_unbounded_serve_wait_only_in_serve_package(tmp_path):
     assert lint_paths(
         [str(path)], rules=build_rules(["unbounded-serve-wait"])
     ) == []
+
+
+# ---------------------------------------------------------------------------
+# untracked-verdict-event
+# ---------------------------------------------------------------------------
+
+
+def test_untracked_verdict_marker_without_emit(tmp_path):
+    """logger.error/.warning lines carrying verdict-class markers with no
+    journal emission in the same function are exactly the ad-hoc
+    narration the telemetry plane replaces (positive fixture 1)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def diagnose(rank):
+            logger.error(f"rank {rank} VERDICT: lease expired")
+
+        def recover(step):
+            logger.warning("SENTINEL REWIND to update %d", step)
+        """,
+        select=["untracked-verdict-event"],
+    )
+    assert rule_names(vs) == ["untracked-verdict-event"] * 2
+    assert "'VERDICT'" in vs[0].message
+    assert "telemetry" in vs[0].message
+
+
+def test_untracked_verdict_all_markers_and_module_level(tmp_path):
+    """Every documented marker trips the rule, including at module level
+    where no enclosing function could ever emit (positive fixture 2)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        logger.error("startup ROLLBACK of the staged config")
+
+        def shed(req):
+            logger.warning(f"SHED request {req}: queue-full")
+
+        def fall_back(a, b):
+            logger.warning(f"CHECKPOINT FALLBACK: {a} -> {b}")
+
+        def name_culprit(msg):
+            logger.error("cross-host DIAGNOSIS: " + msg)
+        """,
+        select=["untracked-verdict-event"],
+    )
+    assert rule_names(vs) == ["untracked-verdict-event"] * 4
+
+
+def test_untracked_verdict_emit_in_same_function_passes(tmp_path):
+    """A journal emission in the same function satisfies the rule — both
+    the `telemetry.emit(...)` and bare `emit(...)` spellings — and the
+    justification comment covers paths that journal one level up
+    (negative fixture 1)."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import logging
+        from unicore_tpu import telemetry
+        logger = logging.getLogger(__name__)
+
+        def diagnose(rank):
+            telemetry.emit("guard-diagnosis", rank=rank)
+            logger.error(f"rank {rank} VERDICT: lease expired")
+
+        def recover(step, emit):
+            emit("sentinel-rewind", step=step)
+            logger.warning("SENTINEL REWIND to update %d", step)
+
+        def relay(msg):
+            logger.error(f"adopted VERDICT: {msg}")  # lint: journal-emitted
+        """,
+        select=["untracked-verdict-event"],
+    )
+    assert vs == []
+
+
+def test_untracked_verdict_benign_lines_and_telemetry_home_pass(tmp_path):
+    """Ordinary warnings without a marker never trip the rule, lowercase
+    prose mentions don't count as markers, and the telemetry package
+    itself is exempt — it IS the journal (negative fixture 2)."""
+    src = """
+    import logging
+    logger = logging.getLogger(__name__)
+
+    def warn(step):
+        logger.warning(f"training slow at update {step}")
+        logger.error("data pipeline stalled; will rewind the reader soon")
+        logger.error("lowercase rollback talk never counts as a marker")
+    """
+    vs = run_lint(tmp_path, src, select=["untracked-verdict-event"])
+    assert vs == []
+    home = tmp_path / "unicore_tpu" / "telemetry"
+    home.mkdir(parents=True)
+    (home / "journal.py").write_text(
+        "import logging\n"
+        "logger = logging.getLogger(__name__)\n"
+        "def warn():\n"
+        "    logger.error('journal VERDICT bookkeeping failed')\n"
+    )
+    assert lint_paths(
+        [str(home / "journal.py")],
+        rules=build_rules(["untracked-verdict-event"]),
+    ) == []
+
+
+def test_untracked_verdict_nested_helper_does_not_excuse_parent(tmp_path):
+    """An emit() inside a NESTED function does not satisfy the enclosing
+    function's verdict line — the emission must be on the same code
+    path."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def outer(rank):
+            def helper():
+                from unicore_tpu import telemetry
+                telemetry.emit("x")
+            logger.error(f"rank {rank} VERDICT: lost")
+        """,
+        select=["untracked-verdict-event"],
+    )
+    assert rule_names(vs) == ["untracked-verdict-event"]

@@ -1,0 +1,865 @@
+"""Unified telemetry plane (unicore_tpu/telemetry/, docs/observability.md):
+journal schema round-trip, the zero-sync sampling contract for step spans,
+cross-host journal merging under skewed clocks, Perfetto JSON validity,
+Prometheus exposition parsing, profiler capture, straggler attribution
+plumbing, and (slow) the 2-process host-loss chaos run whose merged
+timeline must name the verdict rank, the agreed stop update, and the
+restart epoch."""
+
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from unicore_tpu import telemetry
+from unicore_tpu.telemetry import journal, profiler, prometheus, spans, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry(monkeypatch):
+    monkeypatch.delenv(journal.ENV_RUN_ID, raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _ns(tmp_path, **kw):
+    base = dict(
+        save_dir=str(tmp_path), telemetry_dir=None,
+        telemetry_sample_interval=0, metrics_port=0, profile_steps=None,
+    )
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+# ---------------------------------------------------------------------------
+# journal schema
+# ---------------------------------------------------------------------------
+
+
+def test_journal_schema_round_trip(tmp_path):
+    """Every record carries the full envelope; event fields survive a
+    write-read cycle; the step provider stamps the update counter and an
+    explicit update= overrides it."""
+    telemetry.configure(
+        _ns(tmp_path), rank=3, step_provider=lambda: 41, role="trainer"
+    )
+    telemetry.emit("guard-diagnosis", message="rank 1 diverged", extra=7)
+    telemetry.emit("checkpoint-save", update=12, path="/x/c.pt")
+    path = telemetry.journal_path()
+    assert path is not None and os.path.exists(path)
+    assert os.path.basename(path) == "events_rank3.jsonl"
+    records = [json.loads(l) for l in open(path) if l.strip()]
+    # run-start + the two emits
+    assert [r["kind"] for r in records] == [
+        "run-start", "guard-diagnosis", "checkpoint-save",
+    ]
+    for rec in records:
+        for key in trace.ENVELOPE_KEYS:
+            assert key in rec, f"envelope key {key} missing from {rec}"
+        assert rec["rank"] == 3
+        assert rec["run_id"] == telemetry.run_id()
+        assert rec["attempt"] == 0
+    assert records[1]["update"] == 41  # from the step provider
+    assert records[1]["message"] == "rank 1 diverged"
+    assert records[1]["extra"] == 7
+    assert records[2]["update"] == 12  # explicit override wins
+
+
+def test_emit_before_configure_is_safe():
+    telemetry.emit("serve-shed", reason="queue-full")  # must not raise
+    assert telemetry.journal_path() is None
+
+
+def test_run_id_minted_once_and_inherited(tmp_path, monkeypatch):
+    rid = telemetry.ensure_run_id()
+    assert os.environ[journal.ENV_RUN_ID] == rid
+    assert telemetry.ensure_run_id() == rid  # stable within the process
+    # a restarted incarnation (env carries the id + attempt) keeps the id
+    telemetry.configure(_ns(tmp_path), rank=0, role="trainer")
+    assert telemetry.run_id() == rid
+
+
+def test_unserializable_fields_degrade_to_repr(tmp_path):
+    telemetry.configure(_ns(tmp_path), rank=0, role="trainer")
+    telemetry.emit("x", err=ValueError("boom"))
+    records = [json.loads(l) for l in open(telemetry.journal_path())]
+    assert "boom" in records[-1]["err"]
+
+
+# ---------------------------------------------------------------------------
+# spans: the zero-sync sampling contract
+# ---------------------------------------------------------------------------
+
+
+class _Handle:
+    """Stub device buffer: block_until_ready must NEVER be reached on
+    unsampled updates (the stub below intercepts the module seam)."""
+
+
+def _drive(recorder, n_updates, syncs):
+    for u in range(n_updates):
+        recorder.begin_update(u)
+        with recorder.span("dispatch"):
+            pass
+        recorder.note_dispatched(u, _Handle())
+        recorder.end_update(u)
+
+
+def test_unsampled_updates_make_zero_sync_calls(tmp_path, monkeypatch):
+    """The acceptance bound: with sampling disabled there are ZERO device
+    syncs; with interval N only the sampled updates' lag-1 probes sync."""
+    syncs = []
+    monkeypatch.setattr(spans, "_device_sync", lambda h: syncs.append(h))
+    telemetry.configure(_ns(tmp_path), rank=0, role="trainer")
+
+    rec = spans.recorder()
+    rec.configure(sample_interval=0)
+    _drive(rec, 10, syncs)
+    assert syncs == [], "sampling disabled but the device was synced"
+
+    spans.reset()
+    rec = spans.recorder()
+    rec.configure(sample_interval=3)
+    _drive(rec, 10, syncs)
+    # sampled updates 0,3,6,9; each probe collects at the NEXT update's
+    # begin (lag-1), so 9's probe is still pending at loop end
+    assert len(syncs) == 3
+    totals = rec.drain()
+    assert totals["device_samples"] == 3
+    assert totals["host_blocked"] >= 0.0
+
+
+def test_sampled_spans_land_in_journal(tmp_path, monkeypatch):
+    monkeypatch.setattr(spans, "_device_sync", lambda h: None)
+    telemetry.configure(
+        _ns(tmp_path, telemetry_sample_interval=2), rank=0, role="trainer"
+    )
+    rec = spans.recorder()
+    for u in range(4):
+        rec.begin_update(u)
+        with rec.span("data_wait"):
+            pass
+        with rec.span("dispatch"):
+            pass
+        rec.note_dispatched(u, _Handle())
+        rec.end_update(u)
+    records = [json.loads(l) for l in open(telemetry.journal_path())]
+    span_recs = [r for r in records if r["kind"] == "span"]
+    names = {(r["update"], r["name"]) for r in span_recs}
+    # host spans journal on sampled updates 0 and 2; device_busy lands
+    # lag-1 (probe for 0 collected at update 1, for 2 at update 3)
+    assert (0, "dispatch") in names and (2, "dispatch") in names
+    assert (0, "data_wait") in names
+    assert (0, "device_busy") in names and (2, "device_busy") in names
+    assert all(
+        r["update"] % 2 == 0 for r in span_recs
+    ), "an unsampled update journaled a span"
+    for r in span_recs:
+        assert r["dur"] >= 0
+
+
+def test_dispatch_residual_subtracts_nested_phases(tmp_path):
+    telemetry.configure(_ns(tmp_path), rank=0, role="trainer")
+    rec = spans.recorder()
+    rec.begin_update(5)
+    rec.add("plan_exchange", 0.3)
+    rec.add("h2d", 0.2)
+    rec.add_dispatch_residual(1.0)
+    totals = rec.drain()
+    assert totals["dispatch"] == pytest.approx(0.5)
+    assert totals["host_blocked"] == pytest.approx(1.0)
+
+
+def test_spans_outside_open_update_are_dropped(tmp_path):
+    """Validation's plan_exchange/h2d (recorded with no update open) must
+    not poison the dispatch residual or the host_blocked total."""
+    telemetry.configure(_ns(tmp_path), rank=0, role="trainer")
+    rec = spans.recorder()
+    rec.begin_update(1)
+    rec.add("h2d", 0.1)
+    rec.add_dispatch_residual(0.5)
+    rec.end_update(1)
+    # a validation pass between updates records plan/h2d with no bracket
+    rec.add("plan_exchange", 9.0)
+    with rec.span("h2d"):
+        pass
+    rec.begin_update(2)
+    rec.add_dispatch_residual(0.3)  # must NOT go negative from val spans
+    rec.end_update(2)
+    totals = rec.drain()
+    assert totals.get("plan_exchange", 0.0) == 0.0
+    assert totals["h2d"] == pytest.approx(0.1)
+    assert totals["dispatch"] == pytest.approx(0.4 + 0.3)
+    assert totals["host_blocked"] == pytest.approx(0.8)
+
+
+def test_between_span_attributes_to_next_update_and_collects_probe(
+    tmp_path, monkeypatch
+):
+    """data_wait recorded between updates lands on the NEXT update's
+    spans, and entering the between-span resolves the pending lag-1
+    probe (the earliest idle host point)."""
+    syncs = []
+    monkeypatch.setattr(spans, "_device_sync", lambda h: syncs.append(h))
+    telemetry.configure(
+        _ns(tmp_path, telemetry_sample_interval=2), rank=0, role="trainer"
+    )
+    rec = spans.recorder()
+    rec.begin_update(2)
+    rec.end_update(2)
+    rec.note_dispatched(2, _Handle())
+    with rec.between_span("data_wait"):
+        pass
+    assert len(syncs) == 1, "between_span did not collect the probe"
+    rec.begin_update(3)
+    rec.end_update(3)
+    totals = rec.drain()
+    assert totals.get("data_wait", 0.0) >= 0.0
+    records = [json.loads(l) for l in open(telemetry.journal_path())]
+    busy = [r for r in records if r.get("name") == "device_busy"]
+    assert busy and busy[0]["update"] == 2
+    # the stubbed sync returned instantly -> honest upper-bound marker,
+    # journaled but EXCLUDED from the metric (an idle-device gap must
+    # not masquerade as device time)
+    assert busy[0]["upper_bound"] is True
+    assert totals["device_samples"] == 1
+    assert totals["device_busy"] == 0.0
+
+
+def test_step_wall_excludes_between_update_bookkeeping(tmp_path):
+    """The straggler step wall is data_wait + in-step wall: a rank-local
+    checkpoint save between updates must not spike this rank's published
+    wall and get it named the straggler."""
+    import time as _time
+
+    telemetry.configure(_ns(tmp_path), rank=0, role="trainer")
+    rec = spans.recorder()
+    for _ in range(3):
+        rec.begin_update(1)
+        _time.sleep(0.02)  # the in-step wall
+        rec.end_update(1)
+        _time.sleep(0.2)  # a long save/validation tail between updates
+    wall = rec.avg_step_wall()
+    assert 0.0 < wall < 0.1, (
+        f"step wall {wall:.3f}s absorbed the between-update tail"
+    )
+
+
+# ---------------------------------------------------------------------------
+# straggler attribution plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_lease_step_wall_round_trip_and_legacy_decode():
+    from unicore_tpu.distributed import elastic
+
+    lease = elastic.Lease(epoch=2, seq=7, step=100, wall=123.5,
+                          step_wall=0.25)
+    back = elastic.decode_lease(elastic.encode_lease(lease))
+    assert back == lease
+    # a pre-telemetry 5-field lease still decodes (step_wall unknown)
+    legacy = "|".join(elastic.encode_lease(lease).split("|")[:5])
+    back = elastic.decode_lease(legacy)
+    assert back.step == 100 and back.step_wall == -1.0
+
+
+class _FakeKV:
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.store:
+            return self.store[key]
+        raise TimeoutError("deadline exceeded")
+
+
+def test_peer_step_walls_reads_heartbeat_leases(tmp_path):
+    from unicore_tpu.distributed import elastic
+
+    args = argparse.Namespace(
+        heartbeat_interval=1.0, heartbeat_timeout=10.0, elastic=False,
+        save_dir=str(tmp_path),
+    )
+    kv = _FakeKV()
+    runtime = elastic.HeartbeatRuntime(args, nproc=3, rank=0, client=kv,
+                                       collect_peer_walls=True)
+    kv.store[runtime._hb_key(1)] = elastic.encode_lease(
+        elastic.Lease(0, 5, 40, 1.0, 0.75)
+    )
+    kv.store[runtime._hb_key(2)] = elastic.encode_lease(
+        elastic.Lease(0, 5, 40, 1.0)  # no step wall published
+    )
+    # the hot loop only ever reads the cache; the publisher thread owns
+    # the KV round-trips
+    assert runtime.peer_step_walls() == {}
+    runtime._refresh_peer_walls()
+    assert runtime.peer_step_walls() == {1: 0.75}
+
+
+def test_journal_straggler_names_slowest_rank(tmp_path, monkeypatch):
+    from unicore_tpu.distributed import elastic
+
+    telemetry.configure(
+        _ns(tmp_path, telemetry_sample_interval=1), rank=0, role="trainer"
+    )
+    rec = spans.recorder()
+    rec._step_wall_ema = 0.10  # our own published wall
+
+    class _Runtime:
+        rank = 0
+
+        def peer_step_walls(self):
+            return {1: 0.42, 2: 0.2}
+
+    monkeypatch.setattr(elastic, "active_runtime", lambda: _Runtime())
+    spans.journal_straggler(8)
+    records = [json.loads(l) for l in open(telemetry.journal_path())]
+    stragglers = [r for r in records if r["kind"] == "straggler"]
+    assert len(stragglers) == 1
+    assert stragglers[0]["slowest_rank"] == 1
+    assert stragglers[0]["fastest_rank"] == 0
+    assert stragglers[0]["update"] == 8
+
+
+# ---------------------------------------------------------------------------
+# journal merging across skewed host clocks
+# ---------------------------------------------------------------------------
+
+
+def _mk(rank, update, wall, kind="span", attempt=0, **fields):
+    rec = {
+        "run_id": "r", "attempt": attempt, "rank": rank,
+        "membership_epoch": 0, "update": update, "mono": wall,
+        "wall": wall, "kind": kind,
+    }
+    rec.update(fields)
+    return rec
+
+
+def test_merge_corrects_skewed_host_clocks():
+    """Rank 1's wall clock is an hour ahead; the shared update counter
+    anchors the correction, so same-update events interleave instead of
+    rank 1's whole stream sorting after rank 0's."""
+    rank0 = [
+        _mk(0, u, 1000.0 + u, name="dispatch", dur=0.1) for u in range(6)
+    ]
+    rank1 = [
+        _mk(1, u, 3600.0 + 1000.0 + u + 0.4, name="dispatch", dur=0.1)
+        for u in range(6)
+    ]
+    merged = trace.merge(rank0 + rank1)
+    order = [(r["update"], r["rank"]) for r in merged]
+    assert order == [(u, r) for u in range(6) for r in (0, 1)]
+    # corrected times of the same update agree to well under the skew
+    for u in range(6):
+        ts = [r["_t"] for r in merged if r["update"] == u]
+        assert abs(ts[0] - ts[1]) < 5.0
+
+
+def test_merge_never_pairs_anchors_across_attempts():
+    """An elastic restart REPLAYS updates ~60s later on the same host
+    (zero real skew).  Pairing attempt-0 anchors with attempt-1's replay
+    would read the outage as skew and shift the pre-crash stream past
+    the restart — the verdict must stay BEFORE the resume."""
+    a0 = [
+        _mk(0, u, 1000.0 + u, attempt=0, name="dispatch", dur=0.1)
+        for u in range(7)
+    ] + [
+        _mk(0, 6, 1006.5, attempt=0, kind="elastic-verdict",
+            verdict="host-loss", ranks=[1], message="rank 1 lost"),
+    ]
+    a1 = [
+        _mk(0, 4, 1066.0, attempt=1, kind="checkpoint-load",
+            path="c4.pt", loaded_updates=4),
+    ] + [
+        _mk(0, u, 1067.0 + (u - 4), attempt=1, name="dispatch", dur=0.1)
+        for u in range(4, 13)
+    ]
+    merged = trace.merge(a0 + a1)
+    kinds_in_order = [r["kind"] for r in merged]
+    verdict_at = kinds_in_order.index("elastic-verdict")
+    load_at = kinds_in_order.index("checkpoint-load")
+    assert verdict_at < load_at, (
+        "the pre-crash verdict sorted after the restart's resume — "
+        "cross-attempt anchor pairing read the outage gap as clock skew"
+    )
+    # same host, same clock: no offset was invented
+    assert all(r["_t"] == r["wall"] for r in merged)
+
+
+def test_merge_stream_without_shared_updates_keeps_wall():
+    """A serve/supervisor stream with no update anchors falls back to raw
+    wall ordering instead of crashing the merge."""
+    rank0 = [_mk(0, u, 100.0 + u) for u in range(3)]
+    serve = [_mk(5, -1, 101.5, kind="serve-shed", reason="queue-full")]
+    merged = trace.merge(rank0 + serve)
+    kinds = [r["kind"] for r in merged]
+    assert kinds == ["span", "span", "serve-shed", "span"]
+
+
+def test_load_journal_skips_torn_tail_line(tmp_path):
+    p = tmp_path / "events_rank0.jsonl"
+    p.write_text(
+        json.dumps(_mk(0, 1, 10.0)) + "\n" + '{"kind": "torn, no clos'
+    )
+    records = trace.load_journal(str(p))
+    assert len(records) == 1
+
+
+# ---------------------------------------------------------------------------
+# Perfetto JSON validity
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_shape_and_json_validity(tmp_path):
+    merged = trace.merge([
+        _mk(0, 2, 100.0, name="dispatch", dur=0.25),
+        _mk(0, 2, 100.1, name="device_busy", dur=0.2),
+        _mk(1, 2, 100.2, kind="elastic-verdict", verdict="host-loss",
+            ranks=[1], message="rank 1 lease expired"),
+    ])
+    doc = trace.to_chrome_trace(merged)
+    blob = json.dumps(doc)  # must be valid JSON end to end
+    doc = json.loads(blob)
+    events = doc["traceEvents"]
+    assert events, "no trace events emitted"
+    slices = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    assert {e["name"] for e in slices} == {"dispatch", "device_busy"}
+    for e in slices:
+        assert e["dur"] > 0 and e["ts"] >= 0 and isinstance(e["pid"], int)
+    assert any(e["name"] == "elastic-verdict" for e in instants)
+    # metadata rows name the per-rank processes
+    assert any(e.get("ph") == "M" for e in events)
+
+
+def test_trace_cli_end_to_end(tmp_path, capsys):
+    tdir = tmp_path / "telemetry"
+    tdir.mkdir()
+    (tdir / "events_rank0.jsonl").write_text(
+        "\n".join(
+            json.dumps(r)
+            for r in [
+                _mk(0, 4, 50.0, kind="checkpoint-save", path="c4.pt"),
+                _mk(0, 6, 52.0, kind="agreed-stop",
+                    reason="HOST-LOSS(rank 1)"),
+            ]
+        )
+        + "\n"
+    )
+    (tdir / "events_rank1.jsonl").write_text(
+        json.dumps(
+            _mk(1, 6, 52.1, kind="elastic-verdict", verdict="host-loss",
+                ranks=[1], message="rank 1 heartbeat lease expired")
+        )
+        + "\n"
+    )
+    out_json = tmp_path / "trace.json"
+    rc = trace.main([str(tmp_path), "--out", str(out_json)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "merged timeline (2 journal(s))" in out
+    assert "HOST-LOSS" in out
+    assert "agreed stop at update 6" in out
+    assert "last checkpoint save at update 4" in out
+    assert json.load(open(out_json))["traceEvents"]
+
+
+def test_trace_cli_no_journals(tmp_path):
+    assert trace.main([str(tmp_path)]) == 2
+
+
+def test_shed_summary_uses_exact_cumulative_counts():
+    """Shed journaling samples past 5/reason — the summary must report
+    the exact cumulative count each record carries, not the number of
+    sampled records (which under-reports a flood ~40x)."""
+    records = [
+        _mk(0, -1, 100.0 + i, kind="serve-shed", reason="queue-full",
+            count=c)
+        for i, c in enumerate([1, 2, 3, 4, 5, 100, 200, 350])
+    ] + [
+        _mk(0, -1, 110.0, kind="serve-shed", reason="slow-client"),
+    ]
+    lines = trace.summarize(trace.merge(records))
+    shed_line = next(l for l in lines if l.startswith("serve sheds"))
+    assert "queue-full x350" in shed_line
+    assert "slow-client x1" in shed_line
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+_EXPOSITION_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.e+-]+(nan|inf)?)$"
+)
+
+
+def _assert_valid_exposition(text):
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        assert _EXPOSITION_LINE.match(line), f"bad exposition line: {line!r}"
+
+
+def test_registry_render_is_valid_exposition():
+    prometheus.set_gauge("unicore_tpu_train_host_blocked_seconds", 1.25,
+                         help="interval seconds blocked on host work")
+    prometheus.set_counter("unicore_tpu_train_updates_total", 42,
+                           help="updates")
+    prometheus.set_gauge("weird name-with bad$chars", 1.0)
+    prometheus.registry().set(
+        "labeled", 2.0, labels={"reason": 'queue "full"\n'}, type="counter"
+    )
+    prometheus.set_counter("unicore_tpu_big_total", 1234567,
+                           help="a counter past 6 sig figs")
+    prometheus.set_gauge("unicore_tpu_tiny", 0.03)
+    text = prometheus.registry().render()
+    _assert_valid_exposition(text)
+    assert "unicore_tpu_train_updates_total 42" in text
+    assert "weird_name_with_bad_chars 1" in text
+    # full precision: %g-style quantization to 6 sig figs would render
+    # 1.23457e+06 and break rate()/increase() over the counter
+    assert "unicore_tpu_big_total 1234567" in text
+    assert "unicore_tpu_tiny 0.03" in text
+    # label escaping follows the exposition format rules
+    assert 'labeled{reason="queue \\"full\\"\\n"} 2' in text
+
+
+class _StubEngine:
+    def stats(self):
+        return {
+            "phase": "serving", "ready": True, "served": 10,
+            "admitted": 12, "shed": {"queue-full": 3}, "depth": 1,
+            "batches": 4, "buckets": [16, 64], "batch_size": 8,
+            "estimated_delay_s": 0.01, "recompiles_after_warmup": 0,
+            "reloads_applied": 1, "p50_ms": 9.5, "p99_ms": 30.0,
+        }
+
+
+def test_render_engine_exposition_parses():
+    text = prometheus.render_engine(_StubEngine())
+    _assert_valid_exposition(text)
+    assert "unicore_tpu_serve_served_total 10" in text
+    assert 'unicore_tpu_serve_shed_total{reason="queue-full"} 3' in text
+    assert 'unicore_tpu_serve_latency_seconds{quantile="0.99"} 0.03' in text
+
+
+def test_metrics_server_serves_scrape():
+    prometheus.set_gauge("unicore_tpu_test_gauge", 7.0)
+    # port 0 is the flag's "disabled" value — pick a real free port
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free = s.getsockname()[1]
+    server = prometheus.start_metrics_server(free, host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        _assert_valid_exposition(body)
+        assert "unicore_tpu_test_gauge 7" in body
+    finally:
+        server.shutdown()
+
+
+def test_metrics_server_bind_failure_is_nonfatal():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        taken = s.getsockname()[1]
+        assert prometheus.start_metrics_server(taken, host="127.0.0.1") \
+            is None
+
+
+# ---------------------------------------------------------------------------
+# profiler capture
+# ---------------------------------------------------------------------------
+
+
+def test_profile_steps_parsing():
+    assert profiler.parse_profile_steps(None) is None
+    assert profiler.parse_profile_steps("") is None
+    assert profiler.parse_profile_steps("3:9") == (3, 9)
+    for bad in ("3", "a:b", "9:3", "-1:4", "5:5"):
+        with pytest.raises(ValueError):
+            profiler.parse_profile_steps(bad)
+
+
+def test_profiler_capture_smoke(tmp_path):
+    """A real (CPU-backend) jax.profiler window: starts at START, stops at
+    END, leaves a trace artifact, and journals both edges."""
+    telemetry.configure(
+        _ns(tmp_path, profile_steps="2:4"), rank=0, role="trainer"
+    )
+    import jax
+    import jax.numpy as jnp
+
+    for u in range(6):
+        profiler.tick(u)
+        jnp.ones((8, 8)).sum().block_until_ready()  # give it work
+    profiler.close(6)
+    prof_dir = os.path.join(telemetry.journal_dir(_ns(tmp_path)),
+                            "profile_rank0")
+    found = []
+    for root, _, files in os.walk(prof_dir):
+        found.extend(files)
+    assert found, "profiler window produced no trace artifact"
+    records = [json.loads(l) for l in open(telemetry.journal_path())]
+    kinds = [r["kind"] for r in records]
+    assert "profile-start" in kinds and "profile-stop" in kinds
+    start = next(r for r in records if r["kind"] == "profile-start")
+    assert start["update"] == 2 and start["window"] == [2, 4]
+
+
+def test_profiler_window_captures_update_zero(tmp_path):
+    """--profile-steps 0:1 must capture the FIRST update (the compile
+    step) — the trainer ticks BEFORE each update, so tick(0) opens the
+    window before update 0 runs."""
+    telemetry.configure(
+        _ns(tmp_path, profile_steps="0:1"), rank=0, role="trainer"
+    )
+    import jax.numpy as jnp
+
+    profiler.tick(0)  # the pre-update tick for update 0
+    from unicore_tpu.telemetry.profiler import _window
+
+    assert _window is not None and _window.active, (
+        "window 0:1 did not open before update 0"
+    )
+    jnp.ones((4, 4)).sum().block_until_ready()
+    profiler.tick(1)
+    assert _window.done
+    records = [json.loads(l) for l in open(telemetry.journal_path())]
+    start = next(r for r in records if r["kind"] == "profile-start")
+    assert start["update"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve /metrics route (live HTTP)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_http_metrics_route():
+    from unicore_tpu.serve.http import bind_server
+
+    server = bind_server("127.0.0.1", 0, _StubEngine())
+    thread = server.start()
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        _assert_valid_exposition(body)
+        assert "unicore_tpu_serve_served_total 10" in body
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# slow: 2-process host-loss chaos -> merged timeline names the incident
+# ---------------------------------------------------------------------------
+
+_WORKER = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["MASTER_ADDR"] = "127.0.0.1"
+os.environ["MASTER_PORT"] = port
+os.environ["WORLD_SIZE"] = "2"
+os.environ["RANK"] = str(rank)
+sys.path.insert(0, {repo!r})
+sys.argv = ["train.py"] + {argv_common!r} + (
+    {argv_rank0!r} if rank == 0 else {argv_rank1!r}
+)
+from unicore_tpu_cli.train import cli_main
+cli_main()
+"""
+
+_JAX_CACHE = os.environ.get(
+    "UNICORE_TPU_TEST_JAX_CACHE", "/tmp/unicore_tpu_test_jaxcache"
+)
+_SCALE = float(os.environ.get("UNICORE_TPU_TEST_TIMEOUT_SCALE", "0")) or (
+    3.0 if (os.cpu_count() or 2) <= 1 else 1.0
+)
+CLI_TIMEOUT = int(600 * _SCALE)
+_HB_TIMEOUT = 4.0
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bert_data")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "bert", "make_example_data.py"),
+         str(d), "202", "40"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return d
+
+
+def _cli_args(data_dir, save_dir, max_update, extra=()):
+    argv = [
+        str(data_dir),
+        "--task", "bert", "--loss", "masked_lm", "--arch", "bert_tiny",
+        "--optimizer", "adam", "--lr-scheduler", "polynomial_decay",
+        "--lr", "1e-3", "--warmup-updates", "2",
+        "--total-num-update", str(max_update), "--max-update",
+        str(max_update),
+        "--max-epoch", "10", "--batch-size", "8", "--max-seq-len", "64",
+        "--log-interval", "2", "--log-format", "simple",
+        "--save-dir", os.path.join(save_dir, "ckpt"),
+        "--tmp-save-dir", os.path.join(save_dir, "tmp"),
+        "--num-workers", "0", "--seed", "1", "--no-progress-bar",
+        "--required-batch-size-multiple", "1",
+        "--save-interval-updates", "4", "--keep-interval-updates", "10",
+        "--disable-validation",
+    ]
+    if _JAX_CACHE != "0":
+        argv += ["--jax-compilation-cache-dir", _JAX_CACHE]
+    return argv + list(extra)
+
+
+def _run_two_proc_host_loss(data_dir, save_dir):
+    common = _cli_args(
+        data_dir, str(save_dir), 12,
+        extra=["--length-bucket", "1",
+               "--heartbeat-interval", "0.5",
+               "--heartbeat-timeout", str(_HB_TIMEOUT),
+               "--collective-timeout", "120",
+               "--telemetry-sample-interval", "2"],
+    )
+    rank0_extra = ["--elastic", "--max-restarts", "2",
+                   "--restart-backoff", "0.3"]
+    rank1_extra = ["--fault-inject", "host-loss@6@1"]
+    port = _free_port()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if _JAX_CACHE != "0":
+        env["JAX_COMPILATION_CACHE_DIR"] = _JAX_CACHE
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             _WORKER.format(repo=REPO, argv_common=common,
+                            argv_rank0=rank0_extra, argv_rank1=rank1_extra),
+             str(r), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env=env,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=CLI_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_two_proc_chaos_merged_timeline_names_incident(data_dir, tmp_path,
+                                                       capsys):
+    """Acceptance: rank 1 hard-killed at update 6 under --elastic.  The
+    per-host journals, merged by unicore-tpu-trace, must name (1) the
+    HOST-LOSS verdict against rank 1, (2) the agreed stop update, (3) the
+    restart to membership epoch 1 — and carry a nonzero device_busy span
+    (the sampled hot-loop probe was live during the incident)."""
+    for attempt in range(3):
+        save = tmp_path / f"try{attempt}"
+        procs, (out0, out1) = _run_two_proc_host_loss(data_dir, save)
+        # an attempt where the chaos kill never fired, or where the
+        # documented pre-existing gloo CPU-rig flake (PR 4 notes) broke
+        # the run before it finished, proves nothing about telemetry —
+        # retry the scenario
+        invalid = "chaos: HOST LOSS" not in out1 or (
+            "gloo::EnforceNotMet" in out0 + out1
+            and "num_updates: 12" not in out0
+        )
+        if invalid and attempt < 2:
+            print(f"attempt {attempt}: invalid scenario run "
+                  "(gloo flake / chaos never fired), retrying")
+            continue
+        break
+    assert "chaos: HOST LOSS" in out1, out1[-3000:]
+    assert "num_updates: 12" in out0, out0[-6000:]
+
+    tdir = save / "ckpt" / "telemetry"
+    journals = trace.find_journals(str(tdir))
+    assert len(journals) >= 2, f"expected per-host journals, got {journals}"
+
+    rc = trace.main([str(tdir), "--out", str(save / "trace.json")])
+    assert rc == 0
+    merged_out = capsys.readouterr().out
+    print(merged_out[-4000:])  # surfaced for the CI smoke step's grep
+
+    records = []
+    for path in journals:
+        records.extend(trace.load_journal(path))
+    merged = trace.merge(records)
+
+    # (1) the verdict names rank 1 (live in-process, or post-mortem from
+    # the supervisor's silence-age evidence)
+    verdicts = [r for r in merged if r["kind"] == "elastic-verdict"]
+    assert verdicts, "no elastic-verdict event reached any journal"
+    assert any(1 in (v.get("ranks") or []) for v in verdicts)
+    assert "HOST-LOSS" in merged_out or "host-loss" in merged_out
+
+    # (2) an agreed stop update is recorded (the elastic verdict path
+    # stops all survivors at one update), or the child died to jax's
+    # coordination fatal before reaching the stop check — then the
+    # post-mortem restart evidence must exist instead
+    stops = [r for r in merged if r["kind"] == "agreed-stop"]
+    restarts = [r for r in merged if r["kind"] == "elastic-restart"]
+    assert stops or restarts
+    if stops:
+        assert "agreed stop at update" in merged_out
+
+    # (3) the restart advanced the membership epoch to 1
+    assert restarts, "supervisor journaled no elastic-restart event"
+    assert any(r.get("to_epoch") == 1 for r in restarts)
+
+    # nonzero device_busy span from the sampled hot loop
+    busy = [
+        r for r in merged
+        if r["kind"] == "span" and r.get("name") == "device_busy"
+    ]
+    assert busy and any(r["dur"] > 0 for r in busy)
+
+    # the second incarnation shares the run_id with a bumped attempt
+    run_ids = {r["run_id"] for r in merged if r.get("rank") == 0}
+    assert len(run_ids) == 1
+    attempts = {r["attempt"] for r in merged if r.get("rank") == 0}
+    assert {0, 1} <= attempts
+
+    # checkpoint headers carry the same run identity (satellite: v2
+    # header run_id)
+    from unicore_tpu.checkpoint import format as ckpt_format
+
+    header = ckpt_format.read_header(
+        str(save / "ckpt" / "checkpoint_last.pt")
+    )
+    assert header["run_id"] in run_ids
+    assert header["attempt"] == 1

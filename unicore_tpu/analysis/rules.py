@@ -1203,3 +1203,128 @@ class RawCheckpointWrite(LintRule):
             return False
         stream = call.args[1]
         return isinstance(stream, ast.Name) and stream.id in pt_streams
+
+
+# ---------------------------------------------------------------------------
+# 10. untracked-verdict-event
+# ---------------------------------------------------------------------------
+
+#: uppercase emphasis markers the subsystems stamp on verdict-class log
+#: lines (SENTINEL REWIND, RELOAD ROLLBACK, SHED request, CHECKPOINT
+#: FALLBACK, named-rank VERDICT/DIAGNOSIS lines)
+_VERDICT_MARKERS = (
+    "VERDICT", "REWIND", "ROLLBACK", "SHED", "FALLBACK", "DIAGNOSIS",
+)
+
+#: the telemetry package itself is exempt (it IS the journal; anchored at
+#: the unicore_tpu/ component like the other home exemptions)
+_TELEMETRY_HOME = os.path.join("unicore_tpu", "telemetry")
+
+#: receiver names that make a .warning()/.error() call a LOGGER call
+_LOGGER_NAMES = frozenset({"logger", "log", "_logger", "logging"})
+
+
+@register_lint_rule("untracked-verdict-event")
+class UntrackedVerdictEvent(LintRule):
+    name = "untracked-verdict-event"
+    justifications = ("journal-emitted",)
+    description = (
+        "a logger.warning/logger.error whose message carries a "
+        "verdict-class marker (VERDICT/REWIND/ROLLBACK/SHED/FALLBACK/"
+        "DIAGNOSIS) without a telemetry journal emission in the same "
+        "function: the event would exist only as an unparseable text "
+        "line, invisible to unicore-tpu-trace merged timelines — call "
+        "unicore_tpu.telemetry.emit(...) beside the log line, or justify "
+        "with '# lint: journal-emitted' when another function on the "
+        "same path already journals it"
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Violation]:
+        parent = os.path.dirname(os.path.normpath(module.path))
+        if parent == _TELEMETRY_HOME or parent.endswith(
+            os.sep + _TELEMETRY_HOME
+        ):
+            return
+        for fn, calls in self._logger_calls_by_function(module.tree):
+            flagged = [
+                c for c in calls if self._carries_verdict_marker(c)
+            ]
+            if not flagged:
+                continue
+            emits = fn is not None and self._has_journal_emit(fn)
+            if emits:
+                continue
+            for call in flagged:
+                yield _v(
+                    self,
+                    module,
+                    call,
+                    "verdict-class log line (marker "
+                    f"{self._first_marker(call)!r}) never reaches the "
+                    "telemetry journal: add unicore_tpu.telemetry."
+                    "emit(...) in this function so merged timelines see "
+                    "the event, or justify with '# lint: journal-emitted'",
+                )
+
+    # -- helpers -----------------------------------------------------------
+
+    @classmethod
+    def _logger_calls_by_function(cls, tree):
+        """``[(enclosing_function_or_None, [logger warning/error calls
+        inside it])]`` — innermost function wins, so an ``emit()`` in a
+        nested helper doesn't excuse its parent."""
+        bucket = {}
+
+        def walk(node, owner):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner = node
+            if isinstance(node, ast.Call) and cls._is_logger_call(node):
+                bucket.setdefault(id(owner), (owner, []))[1].append(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, owner)
+
+        walk(tree, None)
+        return list(bucket.values())
+
+    @staticmethod
+    def _is_logger_call(call: ast.Call) -> bool:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return False
+        if func.attr not in ("warning", "error"):
+            return False
+        recv = terminal_name(func.value)
+        return recv is not None and recv in _LOGGER_NAMES
+
+    @staticmethod
+    def _literal_text(call: ast.Call) -> str:
+        parts = []
+        for arg in call.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    parts.append(sub.value)
+        return " ".join(parts)
+
+    @classmethod
+    def _carries_verdict_marker(cls, call: ast.Call) -> bool:
+        return cls._first_marker(call) is not None
+
+    @classmethod
+    def _first_marker(cls, call: ast.Call):
+        text = cls._literal_text(call)
+        for marker in _VERDICT_MARKERS:
+            if marker in text:
+                return marker
+        return None
+
+    @staticmethod
+    def _has_journal_emit(fn) -> bool:
+        for node in walk_body(fn):
+            if (
+                isinstance(node, ast.Call)
+                and terminal_name(node.func) == "emit"
+            ):
+                return True
+        return False

@@ -166,6 +166,12 @@ def ckp_copy_fun(src, checkpoints, end_of_epoch, args):
             _durable.tracker().note_failure(dst, e, from_async=True)
             logger.info("copy failed, please copy it manually")
 
+    from unicore_tpu import telemetry
+
+    telemetry.emit(
+        "checkpoint-publish", staged=src,
+        published=published, names=[str(p) for p in checkpoints],
+    )
     try:
         staged_separately = args.tmp_save_dir != args.save_dir
         if staged_separately and published and os.path.lexists(src):
@@ -313,6 +319,13 @@ def save_checkpoint(args, trainer, epoch_itr, val_loss, ckp_copy_thread,
         f"score {val_loss}) "
         f"(writing took {time.monotonic() - write_started} seconds)"
     )
+    from unicore_tpu import telemetry
+
+    telemetry.emit(
+        "checkpoint-save", update=int(updates), epoch=int(epoch),
+        path=staged, names=list(names), val_loss=val_loss,
+        write_seconds=round(time.monotonic() - write_started, 3),
+    )
 
 
 def _emergency_save_checkpoint(args, trainer, epoch_itr, val_loss, kind,
@@ -374,6 +387,13 @@ def _emergency_save_checkpoint(args, trainer, epoch_itr, val_loss, kind,
         _remove_checkpoint(dest)
         os.rename(staged, dest)
         _durable.fsync_dir(args.save_dir)
+    from unicore_tpu import telemetry
+
+    telemetry.emit(
+        "checkpoint-emergency", save_kind=kind, path=dest,
+        landed=saved is not False, seconds=round(elapsed, 3),
+        budget=deadline.budget,
+    )
     if saved is False:
         logger.error(
             f"EMERGENCY SAVE FAILED: {name} did not land after "
@@ -610,6 +630,12 @@ def load_checkpoint(args, trainer, **passthrough_args):
             f"CHECKPOINT CORRUPT: {current} {detail}; falling back to the "
             f"next-newest retained checkpoint {nxt} — training resumes "
             "from an OLDER state than the torn file recorded"
+        )
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "checkpoint-fallback", corrupt=current, fallback=nxt,
+            detail=detail,
         )
         current = nxt
     if extra_state is None:

@@ -565,6 +565,14 @@ class BufferedIterator(object):
                     if budget > self._stall_timeout
                     else ""
                 )
+                from unicore_tpu import telemetry
+
+                telemetry.emit(
+                    "data-stall", budget=round(budget, 1),
+                    position=self._delivered, total=self.total,
+                    context=str(self._context) if self._context else None,
+                    producer_alive=alive,
+                )
                 raise DataStallError(
                     f"data pipeline stalled: the prefetch producer delivered "
                     f"nothing for {budget:.0f}s "

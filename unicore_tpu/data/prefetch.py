@@ -480,6 +480,12 @@ class DevicePrefetcher:
             except _ProducerStopped:
                 raise
             except Exception as e:
+                from unicore_tpu import telemetry
+
+                telemetry.emit(
+                    "prefetch-stall", update=int(seq), waiting_for=int(rank),
+                    timeout=round(self._plan_timeout, 1),
+                )
                 raise PrefetchError(
                     f"slot-plan exchange for update {seq} timed out after "
                     f"{self._plan_timeout:.0f}s waiting for rank {rank} "

@@ -226,28 +226,37 @@ _LEASE_TAG = "uctp-hb1"
 
 @dataclasses.dataclass
 class Lease:
-    """One heartbeat: who is alive, in which incarnation, how far along."""
+    """One heartbeat: who is alive, in which incarnation, how far along.
+
+    ``step_wall`` (smoothed seconds per update; < 0 = unknown) rides the
+    lease so cross-host straggler attribution costs nothing beyond the
+    heartbeat the run already pays — the telemetry spans publish it and
+    sampled updates read the peers' values back
+    (telemetry/spans.journal_straggler)."""
 
     epoch: int
     seq: int
     step: int
     wall: float
+    step_wall: float = -1.0
 
 
 def encode_lease(lease: Lease) -> str:
     return (
         f"{_LEASE_TAG}|{lease.epoch}|{lease.seq}|{lease.step}|"
-        f"{lease.wall:.3f}"
+        f"{lease.wall:.3f}|{lease.step_wall:.6f}"
     )
 
 
 def decode_lease(raw: str) -> Lease:
     parts = str(raw).split("|")
-    if len(parts) != 5 or parts[0] != _LEASE_TAG:
+    # 5 fields: pre-telemetry lease (no step_wall) — still a valid beat
+    if len(parts) not in (5, 6) or parts[0] != _LEASE_TAG:
         raise ValueError(f"not a heartbeat lease: {raw!r}")
     return Lease(
         epoch=int(parts[1]), seq=int(parts[2]), step=int(parts[3]),
         wall=float(parts[4]),
+        step_wall=float(parts[5]) if len(parts) == 6 else -1.0,
     )
 
 
@@ -523,7 +532,9 @@ class HeartbeatRuntime:
     ``--elastic`` — monitors every peer's."""
 
     def __init__(self, args, nproc: int, rank: int, client,
-                 step_fn: Optional[Callable[[], int]] = None):
+                 step_fn: Optional[Callable[[], int]] = None,
+                 step_wall_fn: Optional[Callable[[], float]] = None,
+                 collect_peer_walls: bool = False):
         self.interval = float(getattr(args, "heartbeat_interval", 10.0) or 0.0)
         self.timeout = float(getattr(args, "heartbeat_timeout", 60.0) or 0.0)
         self.epoch = membership_epoch()
@@ -535,6 +546,14 @@ class HeartbeatRuntime:
         self._rank = int(rank)
         self._client = client
         self._step_fn = step_fn
+        self._step_wall_fn = step_wall_fn
+        # telemetry straggler attribution: a DEDICATED thread refreshes
+        # this cache once per heartbeat round — never the training
+        # thread (O(world) serial KV fetches have no place in the hot
+        # loop) and never the publisher (a slow store must not starve
+        # the liveness lease)
+        self._collect_peer_walls = bool(collect_peer_walls)
+        self._peer_walls: Dict[int, float] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._verdict: Optional[Verdict] = None
@@ -589,6 +608,8 @@ class HeartbeatRuntime:
                 pass
         if plane and self.interval > 0:
             self._spawn(self._publish_loop, "elastic-heartbeat-publisher")
+        if plane and self._collect_peer_walls:
+            self._spawn(self._peer_walls_loop, "elastic-peer-walls")
         if plane and self.monitor_enabled and self.timeout > 0:
             if self.interval <= 0:
                 logger.warning(
@@ -626,6 +647,43 @@ class HeartbeatRuntime:
         from unicore_tpu.distributed import guard
 
         guard.set_collective_abort_check(None)
+
+    # -- telemetry surface ------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    def peer_step_walls(self) -> Dict[int, float]:
+        """Every peer's last-seen published step wall (seconds/update).
+        Reads the CACHE the publisher thread refreshes once per
+        heartbeat round — the training thread pays a dict copy, never a
+        KV round-trip.  Empty until the first refresh (or when
+        ``collect_peer_walls`` is off)."""
+        return dict(self._peer_walls)
+
+    def _refresh_peer_walls(self) -> None:
+        """One bounded kv_fetch per peer, on the peer-walls thread.
+        Peers without a lease (or pre-telemetry 5-field leases) are
+        dropped from the cache."""
+        from unicore_tpu.utils import retry
+
+        if self._client is None:
+            return
+        walls: Dict[int, float] = {}
+        for rank in range(self._nproc):
+            if rank == self._rank or self._stop.is_set():
+                continue
+            raw = retry.kv_fetch(self._client, self._hb_key(rank))
+            if not isinstance(raw, str):
+                continue
+            try:
+                lease = decode_lease(raw)
+            except ValueError:
+                continue
+            if lease.step_wall > 0:
+                walls[rank] = lease.step_wall
+        self._peer_walls = walls
 
     # -- verdict surface --------------------------------------------------
 
@@ -665,10 +723,30 @@ class HeartbeatRuntime:
                     self._step_fn() if self._step_fn is not None
                     else guard.last_step()
                 )
-                lease = Lease(self.epoch, seq, int(step), time.time())
+                step_wall = -1.0
+                if self._step_wall_fn is not None:
+                    try:
+                        step_wall = float(self._step_wall_fn())
+                    except Exception:
+                        step_wall = -1.0
+                lease = Lease(
+                    self.epoch, seq, int(step), time.time(), step_wall
+                )
                 self._publish(lease)
             if self._stop.wait(self.interval):
                 return
+
+    def _peer_walls_loop(self) -> None:
+        """Telemetry-only refresh of the peer step-wall cache, on its OWN
+        thread: O(world) serial KV fetches against a slow store must
+        never delay the lease publish that proves this host alive (a
+        starved publisher would age our lease on every peer and mint a
+        FALSE host-loss verdict)."""
+        while not self._stop.wait(self._monitor_interval()):
+            try:
+                self._refresh_peer_walls()
+            except Exception as err:
+                logger.debug(f"peer step-wall refresh failed: {err}")
 
     def _publish(self, lease: Lease) -> None:
         try:
@@ -792,6 +870,16 @@ class HeartbeatRuntime:
             f"{head}: {verdict.message}{src} — membership epoch "
             f"{self.epoch}; requesting an agreed stop of all survivors"
         )
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "elastic-verdict",
+            verdict=verdict.kind,
+            ranks=list(verdict.ranks),
+            message=verdict.message,
+            adopted=verdict.adopted,
+            epoch=self.epoch,
+        )
         if not verdict.adopted:
             try:
                 self._client.key_value_set(
@@ -825,9 +913,15 @@ class HeartbeatRuntime:
 _runtime: Optional[HeartbeatRuntime] = None
 
 
-def start(args, step_fn: Optional[Callable[[], int]] = None):
+def start(args, step_fn: Optional[Callable[[], int]] = None,
+          step_wall_fn: Optional[Callable[[], float]] = None,
+          collect_peer_walls: bool = False):
     """Start the per-process elastic plane (idempotent).  Publisher-only
-    for plain multi-host runs; publisher + monitor under ``--elastic``."""
+    for plain multi-host runs; publisher + monitor under ``--elastic``.
+    ``step_wall_fn`` (telemetry spans) rides each lease for straggler
+    attribution; ``collect_peer_walls`` additionally refreshes the
+    peer-wall cache each publish round (armed only when telemetry span
+    sampling is on)."""
     global _runtime
     if _runtime is not None:
         return _runtime
@@ -841,6 +935,8 @@ def start(args, step_fn: Optional[Callable[[], int]] = None):
         rank=jax.process_index(),
         client=retry.coordination_client(),
         step_fn=step_fn,
+        step_wall_fn=step_wall_fn,
+        collect_peer_walls=collect_peer_walls,
     ).start()
     return _runtime
 
@@ -1071,6 +1167,13 @@ def supervise(args, argv: Sequence[str]) -> int:
         except ValueError:  # not the main thread
             pass
 
+    # the supervisor narrates restarts into the SAME journal stream as
+    # its children (same run_id via the inherited environment, its own
+    # rank file) so a merged timeline shows verdict -> restart -> resume
+    from unicore_tpu import telemetry
+
+    telemetry.configure_supervisor(args, rank)
+
     logger.info(
         f"elastic supervisor: rank {rank}/{world}, membership epoch "
         f"{epoch}, up to {max_restarts} restart(s)"
@@ -1132,6 +1235,17 @@ def supervise(args, argv: Sequence[str]) -> int:
                             for r, why in sorted(lost.items())
                         )
                     )
+                    telemetry.emit(
+                        "elastic-verdict",
+                        verdict="host-loss",
+                        ranks=sorted(lost),
+                        message="post-mortem: " + "; ".join(
+                            f"rank {r} {why}"
+                            for r, why in sorted(lost.items())
+                        ),
+                        adopted=False,
+                        epoch=epoch,
+                    )
             if lost:
                 survivors = [r for r in range(world) if r not in lost]
                 membership = next_membership(survivors, rank)
@@ -1174,6 +1288,18 @@ def supervise(args, argv: Sequence[str]) -> int:
                 f"{reported} ({label}, retryable); restarting as rank "
                 f"{rank}/{world} at membership epoch {epoch} in "
                 f"{delay:.1f}s"
+            )
+            telemetry.emit(
+                "elastic-restart",
+                restarts=restarts,
+                max_restarts=max_restarts,
+                child_exit=reported,
+                child_exit_name=label,
+                from_epoch=epoch - 1,
+                to_epoch=epoch,
+                new_rank=rank,
+                new_world=world,
+                lost={str(r): why for r, why in lost.items()},
             )
             time.sleep(delay)
     finally:

@@ -299,6 +299,11 @@ class ConsistencyGuard:
         )
         diagnosis = diagnose_fingerprints(gathered)
         if diagnosis is not None:
+            from unicore_tpu import telemetry
+
+            telemetry.emit(
+                "guard-diagnosis", update=fp["step"], message=diagnosis
+            )
             raise ConsistencyError(diagnosis)
         logger.debug(f"consistency check passed at step {fp['step']}")
 
@@ -539,6 +544,12 @@ def run_collective(name: str, fn):
         _poisoned = f"'{name}' at step {_last_step}"
         _worker = None  # the old worker is lost inside the stalled call
         logger.error(msg + "\nPython thread stacks at stall:\n" + stacks)
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "collective-stall", update=_last_step, collective=name,
+            aborted_by_verdict=abort_exc is not None, message=msg,
+        )
         if abort_exc is not None:
             raise abort_exc
         raise CollectiveTimeoutError(msg)

@@ -299,6 +299,13 @@ class TrainingHealthSentinel:
         self.events.append(event)
 
         if action == "abort":
+            from unicore_tpu import telemetry
+
+            telemetry.emit(
+                "sentinel-abort", update=int(anomaly.step),
+                detector=anomaly.detector, stat=anomaly.stat,
+                value=float(anomaly.value), message=str(why),
+            )
             raise TrainingHealthError(
                 f"training-health sentinel ABORT: {anomaly.describe()}; "
                 f"{why}.  Recovery history: "
@@ -338,6 +345,16 @@ class TrainingHealthSentinel:
             f"chunk(s) past the offending window{cooldown_note} "
             f"(rewind {self.rewind_count}/{self.max_rewinds}"
             f"{', dropped ' + str(dropped) + ' stale snapshot(s)' if dropped else ''})"
+        )
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "sentinel-rewind", update=int(anomaly.step),
+            detector=anomaly.detector, stat=anomaly.stat,
+            value=float(anomaly.value), threshold=float(anomaly.threshold),
+            action=action, target_step=int(target.step),
+            skipped_chunks=int(skipped),
+            rewind_count=int(self.rewind_count),
         )
 
     def _agree(self, anomaly: Anomaly, target_step: int, action: str) -> None:

@@ -78,6 +78,7 @@ def get_training_parser(default_task=None):
     add_optimization_args(parser)
     add_checkpoint_args(parser)
     add_training_health_args(parser)
+    add_telemetry_args(parser)
     return parser
 
 
@@ -181,6 +182,12 @@ def add_serving_args(parser):
                             "corrupt-reload (bit rot on the next reload "
                             "candidate, proves verify-then-swap rollback);"
                             " STEP counts dispatched serve batches")
+    group.add_argument("--telemetry-dir", metavar="DIR", default=None,
+                       help="per-host event journal for serve-plane "
+                            "events (sheds, reload outcomes, drains; "
+                            "docs/observability.md); default: the served "
+                            "checkpoint's directory + /telemetry.  Merge "
+                            "with unicore-tpu-trace")
     group.add_argument("--seed", type=int, default=1, metavar="N",
                        help="accepted for script compatibility with the "
                             "training CLI; serving is deterministic (eval-"
@@ -661,6 +668,41 @@ def add_training_health_args(parser):
                        help="abort with a diagnosis (detector, step, "
                             "statistic) once N rewinds have been spent "
                             "without the run stabilizing")
+    return group
+
+
+def add_telemetry_args(parser):
+    """Unified telemetry plane (unicore_tpu/telemetry/,
+    docs/observability.md): the per-host JSONL event journal, step-time
+    spans, Prometheus export, and on-demand XLA profiling."""
+    group = parser.add_argument_group("telemetry")
+    group.add_argument("--telemetry-dir", metavar="DIR", default=None,
+                       help="where the per-host event journals "
+                            "(events_rank<r>.jsonl) and profiler traces "
+                            "land (default: <save-dir>/telemetry); merge "
+                            "them with unicore-tpu-trace")
+    group.add_argument("--telemetry-sample-interval", type=int, default=0,
+                       metavar="N",
+                       help="sample step-time spans every N updates: the "
+                            "sampled update journals its data_wait/"
+                            "plan_exchange/h2d/dispatch spans and runs the "
+                            "lag-1 device_busy probe (ONE block_until_ready "
+                            "on the PREVIOUS sampled update's already-"
+                            "finished output — unsampled updates make zero "
+                            "sync calls; 0 disables the probe, host spans "
+                            "still feed the host_blocked metric)")
+    group.add_argument("--metrics-port", type=int, default=0, metavar="N",
+                       help="trainer-side Prometheus /metrics port "
+                            "(text exposition refreshed once per "
+                            "--log-interval; 0 disables).  The serve plane "
+                            "always exposes /metrics on its own HTTP port")
+    group.add_argument("--profile-steps", type=str, default=None,
+                       metavar="START:END",
+                       help="programmatic jax.profiler capture window: "
+                            "each host traces updates START..END into "
+                            "<telemetry-dir>/profile_rank<r>/ and journals "
+                            "profile-start/profile-stop events (bounded "
+                            "alternative to whole-run --profile)")
     return group
 
 

@@ -171,14 +171,21 @@ class AdmissionQueue:
             req.expire(reason)
         else:
             req.shed(reason)
-        # a flood sheds thousands of times in seconds; log the first few
-        # per reason then sample — the per-reason counters in /stats stay
-        # exact either way
+        # a flood sheds thousands of times in seconds; log (and journal)
+        # the first few per reason then sample — the per-reason counters
+        # in /stats stay exact either way
         if count <= 5 or count % 100 == 0:
             logger.warning(
                 f"SHED request {req.request_id}: {reason} #{count} "
                 f"(depth {depth}/{self.capacity}, est-delay {est:.3f}s, "
                 f"deadline-left {req.deadline.remaining():.3f}s)"
+            )
+            from unicore_tpu import telemetry
+
+            telemetry.emit(
+                "serve-shed", reason=str(reason), count=int(count),
+                request_id=req.request_id, depth=int(depth),
+                estimated_delay_s=round(est, 4),
             )
         return False
 

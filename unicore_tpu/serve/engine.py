@@ -262,6 +262,12 @@ class ServeEngine:
             f"RELOAD SWAPPED: serving snapshot replaced on batch boundary "
             f"{self._batch_seq} ({tag})"
         )
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "serve-reload", outcome="swapped-in",
+            batch=int(self._batch_seq), tag=str(tag),
+        )
 
     # -- the loop --------------------------------------------------------
 
@@ -371,10 +377,16 @@ class ServeEngine:
         except retry.WaitTimeoutError:
             drained = False
         self.stop()
+        from unicore_tpu import telemetry
+
         if drained:
             logger.info(
                 f"DRAIN complete: in-flight work flushed in "
                 f"{deadline.elapsed():.2f}s"
+            )
+            telemetry.emit(
+                "serve-drain", outcome="complete",
+                seconds=round(deadline.elapsed(), 3), queued=depth,
             )
         else:
             leftovers = self._flush_undrained()
@@ -382,6 +394,11 @@ class ServeEngine:
                 f"DRAIN deadline exceeded: {leftovers} request(s) abandoned "
                 f"after {deadline.elapsed():.2f}s (each got a terminal "
                 "'draining' response)"
+            )
+            telemetry.emit(
+                "serve-drain", outcome="deadline-exceeded",
+                seconds=round(deadline.elapsed(), 3),
+                abandoned=int(leftovers),
             )
         return drained
 

@@ -7,6 +7,8 @@ in the engine/admission layer; this module only maps outcomes onto HTTP:
 * ``GET /readyz``   → 200 only when the engine is warmed and neither
   reloading nor draining (readiness — what a load balancer routes on);
 * ``GET /stats``    → JSON counters + latency percentiles;
+* ``GET /metrics``  → Prometheus text exposition of the same counters
+  (docs/observability.md);
 * ``POST /v1/infer`` → ``{"tokens": [...], "deadline_ms": N, "id": "..."}``
   → 200 ok / 429 shed (named reason) / 503 not-ready-or-draining /
   504 expired / 408 slow client.
@@ -109,6 +111,17 @@ class ServeHandler(BaseHTTPRequestHandler):
             )
         elif self.path == "/stats":
             self._send_json(200, engine.stats())
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the live engine stats (plus
+            # the process registry) — what a scraper points at
+            from unicore_tpu.telemetry import prometheus as prom
+
+            body = prom.render_engine(engine).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", prom.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"})
 
@@ -216,6 +229,10 @@ class ServeHandler(BaseHTTPRequestHandler):
             # desyncing the connection — close it with the 408
             self.close_connection = True
             logger.warning(f"SHED request: slow-client ({err})")
+            from unicore_tpu import telemetry
+
+            telemetry.emit("serve-shed", reason="slow-client",
+                           message=str(err))
             self._send_json(
                 408, {"status": rq.STATUS_SHED, "reason": "slow-client"}
             )
@@ -265,6 +282,6 @@ def bind_server(host: str, port: int, engine, **kw) -> ServeHTTPServer:
     logger.info(
         f"SERVE listening on http://{server.server_address[0]}:"
         f"{server.server_address[1]} "
-        "(/healthz /readyz /stats /v1/infer)"
+        "(/healthz /readyz /stats /metrics /v1/infer)"
     )
     return server

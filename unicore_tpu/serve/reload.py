@@ -134,6 +134,12 @@ class HotReloader:
                 f"RELOAD VERIFIED: {path} (step {step}) verified + probed; "
                 "swap queued for the next batch boundary"
             )
+            from unicore_tpu import telemetry
+
+            telemetry.emit(
+                "serve-reload", outcome=OUTCOME_SWAPPED, path=path,
+                step=step,
+            )
             return OUTCOME_SWAPPED
         finally:
             # readiness returns regardless of verdict: after a swap we
@@ -148,6 +154,11 @@ class HotReloader:
             f"RELOAD ROLLBACK ({outcome}): {why} — keeping the serving "
             f"snapshot; candidate {path} will not be retried until it is "
             "re-published"
+        )
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "serve-reload", outcome=outcome, path=path, message=why,
         )
         return outcome
 

@@ -45,7 +45,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from unicore_tpu import checkpoint_utils, health, utils
+from unicore_tpu import checkpoint_utils, health, telemetry, utils
 from unicore_tpu.distributed import chaos, elastic, guard
 from unicore_tpu.distributed import utils as distributed_utils
 from unicore_tpu.ema import ema_to_model_dtype, init_ema, update_ema
@@ -797,6 +797,18 @@ class Trainer(object):
 
         metrics.log_start_time("train_wall", priority=800, round=2)
 
+        # step-time spans (telemetry/spans.py): begin_update collects the
+        # lag-1 device_busy probe — the ONLY sync in the spans path, and
+        # only on sampled updates (the previous sampled step's output has
+        # long finished by now, so the block never stalls the pipeline)
+        _spans = telemetry.spans.recorder()
+        _spans.begin_update(self.get_num_updates())
+        # --profile-steps: the PRE-update tick opens a window whose START
+        # is this update (a 0:N window must capture update 0 — usually
+        # the compile step, the most common profiling target)
+        telemetry.profiler.tick(self.get_num_updates())
+        _hot_t0 = time.perf_counter()
+
         state = self._state
         n = len(samples)
 
@@ -858,9 +870,23 @@ class Trainer(object):
                         state, acc, self._step_scalars(0), self._macc
                     )
 
+        finished_update = self.get_num_updates()
+        # dispatch span = hot-block wall minus the separately-recorded
+        # plan_exchange/h2d pieces; note_dispatched retains one tiny
+        # replicated output leaf for the lag-1 device_busy probe (sampled
+        # updates only — unsampled updates retain nothing, so they can
+        # never sync)
+        _spans.add_dispatch_residual(time.perf_counter() - _hot_t0)
+        _spans.note_dispatched(finished_update, new_state["loss_scale"])
         self._state = new_state
         self._cached_eval_params = None
-        self.set_num_updates(self.get_num_updates() + 1)
+        self.set_num_updates(finished_update + 1)
+        _spans.end_update(finished_update)
+        telemetry.spans.journal_straggler(finished_update)
+        # --profile-steps: the POST-update tick closes the window at END
+        # promptly instead of one update late (two int compares when
+        # armed, nothing when not)
+        telemetry.profiler.tick(finished_update + 1)
         # compile observability: count new jit-cache entries and WARN when
         # one appears past --compile-warmup-updates (unstable geometry)
         self._updates_this_process += 1
@@ -1056,6 +1082,10 @@ class Trainer(object):
                 "--length-bucket / --required-batch-size-multiple, or raise "
                 "the warmup if this geometry is expected (epoch tail)."
             )
+            telemetry.emit(
+                "recompile-after-warmup", update=step, new_programs=grew,
+                total_programs=n,
+            )
 
     def _localize_nan(self, samples):
         """Eager re-run of the offending batch: forward with captured
@@ -1180,6 +1210,21 @@ class Trainer(object):
                 "prefetch_wall", prefetch_wall, weight=0, priority=1620,
                 round=3,
             )
+        # step-time span totals (telemetry/spans.py): how much of this
+        # interval the TRAINING THREAD spent blocked on host work, and
+        # the sampled device-occupancy seconds
+        span_totals = telemetry.spans.recorder().drain()
+        if telemetry.spans.recorder().enabled:
+            metrics.log_scalar(
+                "host_blocked", span_totals.get("host_blocked", 0.0),
+                weight=0, priority=1630, round=3,
+            )
+            if span_totals.get("device_samples", 0.0) > 0:
+                metrics.log_scalar(
+                    "device_busy", span_totals.get("device_busy", 0.0),
+                    weight=0, priority=1640, round=3,
+                )
+            self._export_prometheus(n, span_totals)
         # device free-HBM health scalar (reference trainer.py:1086-1124
         # logs gb_free); one host query per flush interval
         mem = utils.get_device_memory_info()
@@ -1189,6 +1234,43 @@ class Trainer(object):
                 gb_free = (stats["bytes_limit"] - stats["bytes_in_use"]) / 1024 ** 3
                 metrics.log_scalar("gb_free", gb_free, weight=0, priority=1500, round=1)
         self.task.reduce_metrics([delta], self.loss)
+
+    def _export_prometheus(self, interval_updates: float, span_totals):
+        """Refresh the process Prometheus registry (served by
+        ``--metrics-port``) once per flush — the scrape path reads host
+        memory only, never the device."""
+        from unicore_tpu.telemetry import prometheus as prom
+
+        prom.set_counter(
+            "unicore_tpu_train_updates_total",
+            float(self.get_num_updates()),
+            help="trainer update counter",
+        )
+        prom.set_counter(
+            "unicore_tpu_train_recompiles_total",
+            float(self._recompile_count),
+            help="train-step programs compiled after the first",
+        )
+        prom.set_gauge(
+            "unicore_tpu_train_interval_updates",
+            float(interval_updates),
+            help="updates folded into the last metrics flush",
+        )
+        for name in ("host_blocked", "device_busy", "data_wait",
+                     "plan_exchange", "h2d", "dispatch"):
+            prom.set_gauge(
+                f"unicore_tpu_train_{name}_seconds",
+                float(span_totals.get(name, 0.0)),
+                help=f"interval seconds in the {name} phase "
+                "(device_busy is lag-1 sampled)",
+            )
+        wall = telemetry.spans.avg_step_wall()
+        if wall > 0:
+            prom.set_gauge(
+                "unicore_tpu_train_step_wall_seconds", wall,
+                help="smoothed wall seconds per update (the value "
+                "heartbeat leases publish for straggler attribution)",
+            )
 
     # ------------------------------------------------------------------
     # training-health sentinel hooks (unicore_tpu/health/)
@@ -1339,9 +1421,10 @@ class Trainer(object):
         # add a length-gather round on the hot path); signatures are tiny.
         # The graceful-stop flag rides along so the CLI's stop decision is
         # collectively agreed without its own per-update collective.
-        gathered = distributed_utils.all_gather_list(
-            (sigs, guard.stop_requested()), max_size=1 << 16
-        )
+        with telemetry.spans.span("plan_exchange"):
+            gathered = distributed_utils.all_gather_list(
+                (sigs, guard.stop_requested()), max_size=1 << 16
+            )
         all_sigs = [row[0] for row in gathered]
         stop_flags = [row[1] for row in gathered]
         modes = plan_slot_modes(
@@ -1379,8 +1462,15 @@ class Trainer(object):
         try:
             yield
         finally:
+            dt = time.perf_counter() - t0
             with self._wall_lock:
-                self._transfer_wall += time.perf_counter() - t0
+                self._transfer_wall += dt
+            # the telemetry h2d span wants TRAINING-THREAD transfers only
+            # (the prefetcher's producer-thread transfers are exactly the
+            # host work the hot loop no longer pays; they still count in
+            # transfer_wall above)
+            if threading.current_thread().name != "device-prefetcher":
+                telemetry.spans.add("h2d", dt)
 
     def _prepare_shard_global(self, sample):
         """Each host contributes its local rows to one global batch laid out
@@ -1835,6 +1925,11 @@ class Trainer(object):
             "mesh": dict(getattr(self.mesh, "shape", None) or {}),
             # which elastic incarnation wrote the file (0 = never re-formed)
             "membership_epoch": elastic.membership_epoch(),
+            # run identity (telemetry/journal.py): joins this file to its
+            # journals, tensorboard/wandb runs, and BENCH rows; restarted
+            # incarnations share the run_id with a bumped attempt
+            "run_id": telemetry.run_id(),
+            "attempt": telemetry.attempt(),
         }
 
     def save_checkpoint(self, filename, extra_state):
@@ -1949,6 +2044,10 @@ class Trainer(object):
                 f"Loaded checkpoint {filename} (epoch "
                 f"{extra_state.get('train_iterator', {}).get('epoch', '?') if extra_state else '?'} "
                 f"@ {self.get_num_updates()} updates)"
+            )
+            telemetry.emit(
+                "checkpoint-load", path=filename,
+                loaded_updates=self.get_num_updates(),
             )
         else:
             logger.info(f"No existing checkpoint found {filename}")

@@ -178,6 +178,16 @@ def main(args) -> int:
     chaos.configure(args)
     logger.info(args)
 
+    # serve-plane event journal (docs/observability.md): sheds, reload
+    # outcomes, drains — default location is beside the served checkpoint
+    from unicore_tpu import telemetry
+
+    if not getattr(args, "telemetry_dir", None):
+        args.telemetry_dir = os.path.join(
+            os.path.dirname(os.path.abspath(args.path)) or ".", "telemetry"
+        )
+    telemetry.configure(args, rank=0, role="serve")
+
     # 1. verified model load -------------------------------------------------
     try:
         model, variables, pad_idx, max_seq_len = load_serving_model(args)

@@ -1,0 +1,25 @@
+#!/usr/bin/env python3
+"""``unicore-tpu-trace`` console entry point — see
+:mod:`unicore_tpu.telemetry.trace` for the actual merger/exporter.
+Pure host-side file crunching: no jax import, runs anywhere the
+journals can be copied to."""
+
+import logging
+import os
+import sys
+
+logging.basicConfig(
+    stream=sys.stderr,
+    level=os.environ.get("LOGLEVEL", "WARNING").upper(),
+    format="%(levelname)s | %(name)s | %(message)s",
+)
+
+
+def main() -> None:
+    from unicore_tpu.telemetry.trace import main as trace_main
+
+    sys.exit(trace_main())
+
+
+if __name__ == "__main__":
+    main()

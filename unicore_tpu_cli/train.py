@@ -175,6 +175,17 @@ class TrainSession:
         reason = self.hard_stop_reason(preempt_sig)
         if reason:
             logger.info(f"stopping training: {reason}")
+            from unicore_tpu import telemetry
+
+            # the collectively-agreed stop point: every survivor journals
+            # the SAME update here, which is what the merged trace's
+            # post-mortem names as "agreed stop"
+            telemetry.emit(
+                "agreed-stop",
+                update=self.trainer.get_num_updates(),
+                reason=reason,
+                signal=str(preempt_sig) if preempt_sig else None,
+            )
         stopping = reason is not None
 
         do_save, do_validate = self.cadence(
@@ -230,7 +241,7 @@ class TrainSession:
 
 
 def main(args) -> None:
-    from unicore_tpu import checkpoint_utils, tasks, utils
+    from unicore_tpu import checkpoint_utils, tasks, telemetry, utils
     from unicore_tpu.distributed import elastic, guard
     from unicore_tpu.distributed import utils as distributed_utils
     from unicore_tpu.logging import metrics
@@ -283,10 +294,26 @@ def main(args) -> None:
         f"{jax.process_count()} hosts"
     )
 
+    # unified telemetry plane (docs/observability.md): the per-host event
+    # journal + step spans + profiler window, and the optional Prometheus
+    # port.  Configured BEFORE elastic.start so heartbeat leases can
+    # publish the spans' step wall for straggler attribution.
+    telemetry.configure(
+        args, rank=jax.process_index(),
+        step_provider=trainer.get_num_updates, role="trainer",
+    )
+    from unicore_tpu.telemetry import prometheus as _prom
+
+    _prom.start_metrics_server(getattr(args, "metrics_port", 0) or 0)
+
     # elastic control plane: publish this host's liveness lease (always on
     # for multi-host runs); under --elastic, also monitor every peer's and
     # turn lease expiry into a named-rank verdict + agreed stop + restart
-    elastic_runtime = elastic.start(args, step_fn=trainer.get_num_updates)
+    elastic_runtime = elastic.start(
+        args, step_fn=trainer.get_num_updates,
+        step_wall_fn=telemetry.spans.avg_step_wall,
+        collect_peer_walls=telemetry.spans.recorder().sample_interval > 0,
+    )
 
     task.load_dataset(args.train_subset, combine=False, epoch=1)
     extra_state, epoch_itr = restore_session(args, trainer)
@@ -323,6 +350,9 @@ def main(args) -> None:
     finally:
         if profiling:
             jax.profiler.stop_trace()
+        # a --profile-steps window still open at run end (or at an error
+        # unwind) must close cleanly, not leave a torn trace
+        telemetry.profiler.close(trainer.get_num_updates())
         session.close()
         # elastic runtime deliberately NOT stopped here: its monitor keeps
         # working toward a verdict while a terminal error unwinds, so the
@@ -382,8 +412,12 @@ def restore_session(args, trainer):
     return extra_state, epoch_itr
 
 
+_EPOCH_DONE = object()
+
+
 def train_epoch(args, session, epoch_itr):
     """Run one epoch of updates; returns (valid_losses, should_stop)."""
+    from unicore_tpu import telemetry
     from unicore_tpu.data import iterators
     from unicore_tpu.distributed import utils as distributed_utils
     from unicore_tpu.logging import metrics
@@ -418,12 +452,27 @@ def train_epoch(args, session, epoch_itr):
             wandb_name=args.wandb_name,
         )
 
+        # run identity into the external sinks (tensorboard text / wandb
+        # config): run_id + attempt + journal path make the dashboards
+        # joinable with journals, checkpoint headers, and BENCH rows
+        progress.log_config(telemetry.log_config_payload(args))
+
         trainer.begin_epoch(epoch)
         valid_losses, stop = [None], False
         num_updates = trainer.get_num_updates()
 
         try:
-            for grouped_samples in progress:
+            progress_iter = iter(progress)
+            while True:
+                # data_wait between-span: how long the training thread
+                # sat waiting on the (possibly prefetched) iterator —
+                # attributed to the NEXT update; entering it also
+                # resolves the pending lag-1 device_busy probe at the
+                # earliest idle host point
+                with telemetry.spans.recorder().between_span("data_wait"):
+                    grouped_samples = next(progress_iter, _EPOCH_DONE)
+                if grouped_samples is _EPOCH_DONE:
+                    break
                 with metrics.aggregate("train_inner"):
                     step_ok = trainer.train_step(grouped_samples) is not None
                     # training-health sentinel tick (no-op unless
@@ -560,12 +609,17 @@ def cli_main(modify_parser: Optional[Callable] = None) -> None:
 
     force_host_cpu_from_env(default_devices=8)
 
-    from unicore_tpu import options
+    from unicore_tpu import options, telemetry
     from unicore_tpu.distributed import elastic
     from unicore_tpu.distributed import utils as distributed_utils
 
     parser = options.get_training_parser()
     args = options.parse_args_and_arch(parser, modify_parser=modify_parser)
+
+    # mint (or inherit) the run identity BEFORE any child can spawn: the
+    # --elastic supervisor passes its environment through, so restarted
+    # incarnations share the run_id and differ only in the attempt count
+    telemetry.ensure_run_id()
 
     if getattr(args, "elastic", False) and not elastic.is_child():
         # --elastic: this process becomes the per-host supervisor; training
