@@ -208,6 +208,7 @@ def _evo_stack(pipeline: bool):
     )
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget (PR-4 convention): the deep-composition legs exceed the 'not slow' 870s ceiling on a 1-core CPU box
 def test_evoformer_pipeline_matches_plain(mesh):
     """Pipelined EvoformerStack == plain block loop, forward and param
     gradients, on a dp x pp mesh — both streams (msa, pair) ride the ring."""

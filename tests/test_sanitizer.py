@@ -1,0 +1,477 @@
+"""Runtime collective sanitizer (ISSUE 9): pre-collective fingerprint
+exchange over the KV plane — a rank that skips/reorders a host collective
+(or carries mismatched payload geometry) is NAMED in a
+CollectiveDivergenceError before anyone enters the collective, instead of
+every healthy rank hanging to --collective-timeout."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from argparse import Namespace
+
+import pytest
+
+from unicore_tpu.distributed import chaos, guard, sanitizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    yield
+    chaos.reset()
+    guard.reset()
+    sanitizer.reset()
+
+
+def _arm(**over):
+    base = dict(sanitize_collectives=True, sanitize_timeout=5.0)
+    base.update(over)
+    sanitizer.configure(Namespace(**base))
+
+
+def _fp(site, geom=None, step=7):
+    return {"site": site, "geom": geom, "step": step}
+
+
+# ---------------------------------------------------------------------------
+# chaos kind
+# ---------------------------------------------------------------------------
+
+
+def test_parse_collective_order_skew():
+    p = chaos.parse_fault_spec("collective-order-skew@3@1")
+    assert (p.kind, p.step, p.rank) == ("collective-order-skew", 3, 1)
+    # defaults to the LAST rank, like the other divergence kinds
+    p = chaos.parse_fault_spec("collective-order-skew@3")
+    assert p._rank is None
+
+
+def test_collective_skip_is_consumed_once():
+    chaos.configure(Namespace(fault_inject="collective-order-skew@2@0"))
+    chaos.note_step(1)
+    assert not chaos.take_collective_skip("barrier")  # before the trigger
+    chaos.note_step(2)
+    assert chaos.take_collective_skip("barrier")
+    assert not chaos.take_collective_skip("barrier")  # consumed
+
+
+def test_run_collective_skip_returns_none_without_entering():
+    """The skipped collective's body must NOT run (that is the point:
+    this rank's control flow 'never reached' it) and the sanitizer seq
+    counter must not advance."""
+    chaos.configure(Namespace(fault_inject="collective-order-skew@0@0"))
+    chaos.note_step(0)
+    entered = []
+    out = guard.run_collective("barrier:x", lambda: entered.append(1) or 1)
+    assert out is None and entered == []
+    assert sanitizer._seq == 0
+
+
+# ---------------------------------------------------------------------------
+# verdict diagnosis (majority vote)
+# ---------------------------------------------------------------------------
+
+
+def test_diagnose_strict_majority_names_divergent_rank():
+    _arm()
+    v = sanitizer._diagnose(
+        "barrier:x",
+        5,
+        0,
+        {0: _fp("barrier:x"), 1: _fp("all_gather_list"), 2: _fp("barrier:x")},
+        [],
+    )
+    assert v is not None and "DIVERGED" in v
+    assert "rank(s) 1" in v and "all_gather_list" in v
+    assert "ambiguous" not in v
+
+
+def test_diagnose_two_rank_tie_names_suspect_with_ambiguity_note():
+    """2 hosts can't form a strict majority: the rank differing from
+    rank 0 is named as the SUSPECT and the verdict says the vote is
+    ambiguous (guard.diagnose_fingerprints convention)."""
+    _arm()
+    v = sanitizer._diagnose(
+        "barrier:x", 5, 0, {0: _fp("barrier:x"), 1: _fp("all_reduce")}, []
+    )
+    assert v is not None and "rank(s) 1" in v and "ambiguous" in v
+
+
+def test_vote_tied_pluralities_never_anchor_an_outvoted_rank0():
+    """{A: [0], B: [1,2], C: [3,4]}: rank 0 is the lone outlier — the
+    tie between B and C must not anchor the verdict on rank 0's group
+    and name the four plurality ranks as the suspects."""
+    divergent, reference, ambiguous = sanitizer._vote(
+        {"A": [0], "B": [1, 2], "C": [3, 4]}
+    )
+    assert ambiguous
+    assert reference in ("B", "C")
+    assert 0 in divergent
+
+
+def test_diagnose_step_lag_same_site():
+    """A rank that skipped a PERIODIC collective (identical site and
+    geometry every interval) arrives one training step behind — the step
+    field must catch what site/geometry comparison cannot, or payloads
+    silently cross steps for the rest of the run."""
+    _arm()
+    v = sanitizer._diagnose(
+        "all_reduce_dict",
+        7,
+        0,
+        {
+            0: _fp("all_reduce_dict", "keys=loss,ups", step=100),
+            1: _fp("all_reduce_dict", "keys=loss,ups", step=101),
+            2: _fp("all_reduce_dict", "keys=loss,ups", step=100),
+        },
+        [],
+    )
+    assert v is not None and "DIFFERENT" in v
+    assert "rank(s) 1" in v and "step 101" in v
+
+
+def test_diagnose_geometry_mismatch():
+    _arm()
+    v = sanitizer._diagnose(
+        "all_reduce",
+        2,
+        0,
+        {
+            0: _fp("all_reduce", "shape=(3,)"),
+            1: _fp("all_reduce", "shape=(4,)"),
+            2: _fp("all_reduce", "shape=(3,)"),
+        },
+        [],
+    )
+    assert v is not None and "MISMATCHED" in v
+    assert "rank(s) 1" in v and "shape=(4,)" in v
+
+
+def test_diagnose_geometry_none_is_not_compared():
+    """Wrappers pass geometry only for geometry-rigid collectives;
+    all_gather_list/broadcast payloads legitimately differ per rank and
+    report None — never a verdict."""
+    _arm()
+    v = sanitizer._diagnose(
+        "all_gather_list",
+        2,
+        0,
+        {0: _fp("all_gather_list", None), 1: _fp("all_gather_list", None)},
+        [],
+    )
+    assert v is None
+
+
+def test_diagnose_stranded_rank():
+    _arm()
+    v = sanitizer._diagnose(
+        "barrier:x", 9, 0, {0: _fp("barrier:x"), 1: None, 2: _fp("barrier:x")},
+        [1],
+    )
+    assert v is not None and "rank(s) 1" in v
+    assert "never reached host collective #9" in v
+
+
+def test_diagnose_agreement_is_silent():
+    _arm()
+    assert (
+        sanitizer._diagnose(
+            "b", 0, 0, {0: _fp("b", "g"), 1: _fp("b", "g")}, []
+        )
+        is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# exchange flow on a fake KV client
+# ---------------------------------------------------------------------------
+
+
+class FakeKV:
+    def __init__(self):
+        self.store = {}
+        self.deleted = []
+
+    def key_value_set(self, key, value):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.store:
+            return self.store[key]
+        raise RuntimeError(f"Deadline Exceeded waiting for {key} (timed out)")
+
+    def key_value_delete(self, key):
+        self.deleted.append(key)
+
+
+@pytest.fixture
+def fake_cluster(monkeypatch):
+    """2-process world on a FakeKV: this process is rank 0."""
+    import jax
+
+    from unicore_tpu.utils import retry
+
+    kv = FakeKV()
+    monkeypatch.setattr(retry, "coordination_client", lambda: kv)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    return kv
+
+
+def test_check_clean_exchange(fake_cluster):
+    _arm()
+    # peer already published the matching fingerprint for seq 0
+    fake_cluster.store[f"{sanitizer._prefix}/0/1"] = json.dumps(
+        _fp("barrier:x", None, 0)
+    )
+    sanitizer.check("barrier:x")  # no raise
+    assert sanitizer._seq == 1
+    mine = json.loads(fake_cluster.store[f"{sanitizer._prefix}/0/0"])
+    assert mine["site"] == "barrier:x"
+
+
+def test_check_site_mismatch_raises_named(fake_cluster):
+    _arm()
+    fake_cluster.store[f"{sanitizer._prefix}/0/1"] = json.dumps(
+        _fp("all_gather_list", None, 0)
+    )
+    with pytest.raises(sanitizer.CollectiveDivergenceError) as ei:
+        sanitizer.check("barrier:x")
+    assert "rank(s) 1" in str(ei.value)
+
+
+def test_check_stranded_peer_times_out_bounded(fake_cluster):
+    """A peer that never publishes surfaces as a named stranded-rank
+    verdict once --sanitize-timeout expires — bounded, never a hang."""
+    _arm(sanitize_timeout=0.6)
+    t0 = time.monotonic()
+    with pytest.raises(sanitizer.CollectiveDivergenceError) as ei:
+        sanitizer.check("barrier:x")
+    elapsed = time.monotonic() - t0
+    assert "never reached host collective #0" in str(ei.value)
+    assert "rank(s) 1" in str(ei.value)
+    assert elapsed < 5.0
+
+
+def test_check_geometry_rides_the_exchange(fake_cluster):
+    _arm()
+    fake_cluster.store[f"{sanitizer._prefix}/0/1"] = json.dumps(
+        _fp("all_reduce", "shape=(4,) dtype=float64 op=sum", 0)
+    )
+    with pytest.raises(sanitizer.CollectiveDivergenceError) as ei:
+        sanitizer.check("all_reduce", "shape=(3,) dtype=float64 op=sum")
+    assert "MISMATCHED" in str(ei.value)
+
+
+def test_check_journals_the_verdict(fake_cluster, tmp_path):
+    from unicore_tpu import telemetry
+
+    telemetry.configure(
+        Namespace(telemetry_dir=str(tmp_path)), rank=0, role="trainer"
+    )
+    _arm()
+    fake_cluster.store[f"{sanitizer._prefix}/0/1"] = json.dumps(
+        _fp("all_gather_list", None, 0)
+    )
+    with pytest.raises(sanitizer.CollectiveDivergenceError):
+        sanitizer.check("barrier:x")
+    records = [
+        json.loads(l)
+        for l in open(telemetry.journal_path())
+        if l.strip()
+    ]
+    events = [r for r in records if r["kind"] == "collective-divergence"]
+    assert len(events) == 1
+    assert events[0]["collective"] == "barrier:x"
+    assert "rank(s) 1" in events[0]["verdict"]
+
+
+def test_kv_outage_degrades_to_unverified_not_false_divergence(
+    fake_cluster, monkeypatch
+):
+    """Every peer missing AND our own key unreadable = the KV plane is
+    dark, not the peers: the exchange must degrade to an unverified
+    collective (warning + journal) — never a verdict blaming every
+    healthy peer for a service outage."""
+    from unicore_tpu.utils import retry
+
+    _arm(sanitize_timeout=0.4)
+    monkeypatch.setattr(
+        retry, "kv_fetch", lambda client, key, **kw: retry.UNREACHABLE
+    )
+    sanitizer.check("barrier:x")  # no raise; proceeds unverified
+    assert sanitizer._seq == 1
+
+
+def test_publish_failure_degrades_to_unverified(fake_cluster, monkeypatch):
+    """A dark KV service at PUBLISH time takes the same degrade path as
+    dark reads — never an opaque backend traceback out of the exchange."""
+    _arm()
+
+    def boom(key, value):
+        raise RuntimeError("UNAVAILABLE: connection reset")
+
+    monkeypatch.setattr(fake_cluster, "key_value_set", boom)
+    sanitizer.check("barrier:x")  # no raise; proceeds unverified
+
+
+def test_old_exchanges_are_garbage_collected(fake_cluster):
+    _arm()
+    for seq in range(sanitizer._GC_LAG + 2):
+        fake_cluster.store[f"{sanitizer._prefix}/{seq}/1"] = json.dumps(
+            _fp("b", None, 0)
+        )
+        sanitizer.check("b")
+    assert any(
+        d.endswith("/0/") or "/0/" in d for d in fake_cluster.deleted
+    ), fake_cluster.deleted
+
+
+def test_disabled_or_single_process_is_a_noop(monkeypatch):
+    sanitizer.reset()
+    sanitizer.check("barrier:x")  # disarmed: no client, no raise
+    _arm()
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    assert not sanitizer.enabled()  # single-process never exchanges
+
+
+def test_divergence_error_is_a_consistency_error():
+    """The elastic supervisor and the exit-code taxonomy classify by the
+    guard's error hierarchy; the sanitizer's verdicts must ride it."""
+    assert issubclass(
+        sanitizer.CollectiveDivergenceError, guard.ConsistencyError
+    )
+
+
+# ---------------------------------------------------------------------------
+# 2-process end-to-end: collective-order-skew chaos
+# ---------------------------------------------------------------------------
+
+_PREAMBLE = r"""
+import os, sys, time
+rank = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n, process_id=rank)
+sys.path.insert(0, "__REPO__")
+
+from argparse import Namespace
+from unicore_tpu import telemetry
+from unicore_tpu.distributed import chaos, guard, sanitizer
+from unicore_tpu.distributed import utils as du
+"""
+
+SKEW_WORKER = _PREAMBLE + r"""
+tdir = f"/tmp/unicore_sanitize_{port}"
+os.makedirs(tdir, exist_ok=True)
+telemetry.configure(Namespace(telemetry_dir=tdir), rank=rank, role="trainer")
+
+# a generous collective watchdog: the acceptance criterion is that the
+# SANITIZER names the rank within ~one --sanitize-timeout, far before
+# this deadline would fire
+args = Namespace(
+    seed=7, collective_timeout=120.0,
+    sanitize_collectives=True, sanitize_timeout=20.0,
+    fault_inject="collective-order-skew@0@1",
+)
+guard.configure(args)
+chaos.configure(args)
+sanitizer.configure(args)
+chaos.note_step(0)
+
+t0 = time.monotonic()
+try:
+    # rank 1's chaos skips THIS collective; rank 0 enters its exchange
+    # and waits for rank 1's fingerprint
+    du.all_gather_list({"rank": rank})
+    # rank 1 arrives HERE immediately after the skip: its fingerprint for
+    # seq 0 says 'barrier:post-skew' while rank 0's says
+    # 'all_gather_list' — both sides get the verdict in ONE exchange
+    du.barrier("post-skew")
+    print(f"RANK{rank}_NO_VERDICT", flush=True)
+except sanitizer.CollectiveDivergenceError as e:
+    dt = time.monotonic() - t0
+    print(f"RANK{rank}_SANITIZER_FIRED after {dt:.1f}s: {e}", flush=True)
+except BaseException as e:
+    print(f"RANK{rank}_WRONG_ERROR {type(e).__name__}: {e}", flush=True)
+if rank == 0:
+    # rank 0 hosts the coordination service: exiting the instant the
+    # verdict fires would tear the KV plane out from under rank 1's
+    # in-flight exchange (jax's PollForError kills the peer fatally)
+    time.sleep(5)
+import os as _os
+_os._exit(0)
+"""
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
+@pytest.mark.slow
+def test_two_process_order_skew_named_by_sanitizer():
+    """Acceptance (ISSUE 9): chaos makes rank 1 skip a host collective;
+    with --sanitize-collectives armed BOTH ranks abort with a
+    CollectiveDivergenceError naming rank 1 within one fingerprint
+    exchange — not the 120s collective-timeout deadline — and the
+    verdict is journaled."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", SKEW_WORKER.replace("__REPO__", REPO),
+             str(r), "2", port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for r, out in enumerate(outs):
+        assert f"RANK{r}_SANITIZER_FIRED" in out, f"rank {r}:\n{out[-5000:]}"
+        assert "rank(s) 1" in out, out[-5000:]
+        assert "DIVERGED" in out, out[-5000:]
+    # the skip itself was logged by chaos on rank 1
+    assert "collective-order-skew" in outs[1]
+    # detection bound: within ~one sanitize-timeout, nowhere near the
+    # 120s collective watchdog
+    import re
+
+    for out in outs:
+        m = re.search(r"SANITIZER_FIRED after ([0-9.]+)s", out)
+        assert m is not None and float(m.group(1)) < 60.0, out[-2000:]
+    # journaled via the PR-8 telemetry plane on rank 0
+    tdir = f"/tmp/unicore_sanitize_{port}"
+    journal = os.path.join(tdir, "events_rank0.jsonl")
+    assert os.path.exists(journal)
+    events = [
+        json.loads(l) for l in open(journal) if l.strip()
+    ]
+    divergence = [
+        e for e in events if e.get("kind") == "collective-divergence"
+    ]
+    assert divergence and "rank(s) 1" in divergence[0]["verdict"]
+    # surfaced for the CI chaos smoke step's grep (run with pytest -s)
+    print("\nSANITIZER-VERDICT:", divergence[0]["verdict"][:300])

@@ -29,6 +29,11 @@ from unicore_tpu.analysis.core import (  # noqa: F401
 # importing the rule modules registers the built-in rules
 import unicore_tpu.analysis.rules  # noqa: E402,F401
 import unicore_tpu.analysis.dead_flags  # noqa: E402,F401
+# whole-program engine + the interprocedural analyses riding it
+import unicore_tpu.analysis.collective_divergence  # noqa: E402,F401
+import unicore_tpu.analysis.sharding_legality  # noqa: E402,F401
+import unicore_tpu.analysis.shared_state  # noqa: E402,F401
+import unicore_tpu.analysis.escapes  # noqa: E402,F401
 
 __all__ = [
     "LINT_RULE_REGISTRY",

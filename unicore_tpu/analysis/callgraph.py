@@ -1,0 +1,266 @@
+"""Project-wide call graph: the substrate for whole-program analyses.
+
+PR 1's rules are intraprocedural (one ``ast`` tree at a time), with two
+ad-hoc exceptions that each re-derived their own reachability
+(``sync-transfer-in-step``'s train_step closure, ``tracing.py``'s traced
+transitive closure).  Every interesting parallel-plane bug spans a call
+graph — a rank guard three frames above the barrier it strands, a field
+mutated by a helper the Thread target reaches — so this module builds ONE
+shared index over every linted module:
+
+* :class:`FunctionInfo` — a function/method def plus where it lives
+  (module, enclosing class, enclosing function for closures);
+* :class:`ProjectCallGraph` — defs indexed by bare name and by
+  ``Class.method``, call-site resolution, transitive reachability, and
+  thread-spawn root discovery (``threading.Thread(target=...)`` —
+  including targets forwarded through a parameter of a spawn helper).
+
+Resolution is by terminal callee name, the same conservative
+over-approximation the intraprocedural rules already trade on: dynamic
+dispatch and aliasing are invisible, a name collision merges candidates,
+and ``# lint: <rule>`` escapes absorb the deliberate exceptions.  The
+refinements that matter in this codebase ARE modeled: ``self.foo()``
+prefers methods named ``foo`` on the caller's own class, bare ``foo()``
+prefers same-module defs before falling back project-wide.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from unicore_tpu.analysis.core import ModuleInfo, terminal_name
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionInfo:
+    """One function/method definition and its home."""
+
+    module: ModuleInfo
+    node: ast.AST
+    #: enclosing ``ClassDef`` name, or None for module-level functions
+    class_name: Optional[str]
+    #: enclosing function's name for closures/nested defs, else None
+    parent_func: Optional[str]
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def qualname(self) -> str:
+        cls = f"{self.class_name}." if self.class_name else ""
+        return f"{self.module.path}::{cls}{self.node.name}"
+
+    def __repr__(self) -> str:  # stable in test failure output
+        return f"FunctionInfo({self.qualname})"
+
+
+def body_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Call expressions in ``fn``'s own body, not in nested def/class
+    scopes (those are their own :class:`FunctionInfo`\\ s)."""
+    from unicore_tpu.analysis.tracing import walk_body
+
+    for node in walk_body(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+class ProjectCallGraph:
+    """Call graph over every module handed to the lint driver."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = list(modules)
+        self.functions: List[FunctionInfo] = []
+        #: bare name -> defs with that name, project-wide
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        #: (module path, bare name) -> defs in that module
+        self.by_module_name: Dict[Tuple[str, str], List[FunctionInfo]] = {}
+        #: (module path, class name, method name) -> defs on that class
+        self.by_method: Dict[Tuple[str, str, str], List[FunctionInfo]] = {}
+        self._info_by_node: Dict[int, FunctionInfo] = {}
+        for module in self.modules:
+            self._index_module(module)
+
+    # -- construction ------------------------------------------------------
+
+    def _index_module(self, module: ModuleInfo) -> None:
+        def visit(node, class_name, parent_func):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_DEFS):
+                    info = FunctionInfo(module, child, class_name, parent_func)
+                    self.functions.append(info)
+                    self.by_name.setdefault(child.name, []).append(info)
+                    self.by_module_name.setdefault(
+                        (module.path, child.name), []
+                    ).append(info)
+                    if class_name is not None:
+                        self.by_method.setdefault(
+                            (module.path, class_name, child.name), []
+                        ).append(info)
+                    self._info_by_node[id(child)] = info
+                    visit(child, None, child.name)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, parent_func)
+                else:
+                    visit(child, class_name, parent_func)
+
+        visit(module.tree, None, None)
+
+    # -- lookup ------------------------------------------------------------
+
+    def info_for(self, node: ast.AST) -> Optional[FunctionInfo]:
+        return self._info_by_node.get(id(node))
+
+    def resolve_call(
+        self, caller: FunctionInfo, call: ast.Call
+    ) -> List[FunctionInfo]:
+        """Candidate callees for one call site.
+
+        ``self.foo()``/``cls.foo()`` prefers methods named ``foo`` on the
+        caller's own class; bare/attribute ``foo()`` prefers same-module
+        defs, then falls back to every def named ``foo`` project-wide.
+        Unresolvable calls (builtins, third-party) return [].  One
+        resolution routine serves calls AND bare callable references, so
+        call-edge and Thread-target resolution can never drift apart.
+        """
+        return self.resolve_callable_ref(caller, call.func)
+
+    def resolve_callable_ref(
+        self, owner: FunctionInfo, expr: ast.AST
+    ) -> List[FunctionInfo]:
+        """Defs a bare callable REFERENCE (not call) may denote —
+        ``self.run``, ``worker``, ``module.worker`` — resolved with the
+        same preferences as :meth:`resolve_call`."""
+        name = terminal_name(expr)
+        if name is None:
+            return []
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")
+            and owner.class_name is not None
+        ):
+            own = self.by_method.get((owner.module.path, owner.class_name, name))
+            if own:
+                return list(own)
+        local = self.by_module_name.get((owner.module.path, name))
+        if local:
+            return list(local)
+        return list(self.by_name.get(name, ()))
+
+    # -- reachability ------------------------------------------------------
+
+    def reachable(
+        self, roots: Iterable[FunctionInfo]
+    ) -> Set[FunctionInfo]:
+        """Transitive closure over resolved call sites, roots included."""
+        seen: Set[FunctionInfo] = set()
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            for call in body_calls(fn.node):
+                for callee in self.resolve_call(fn, call):
+                    if callee not in seen:
+                        stack.append(callee)
+        return seen
+
+    # -- thread spawns -----------------------------------------------------
+
+    def thread_roots(self) -> List[Tuple[FunctionInfo, "FunctionInfo", ast.Call]]:
+        """``(spawning function, thread target def, Thread(...) call)``
+        triples for every resolvable ``threading.Thread(target=...)``.
+
+        Two shapes are resolved: a direct callable (``target=self._loop``,
+        ``target=worker``), and a target forwarded through a PARAMETER of
+        the spawning function (``def _spawn(target): Thread(target=target)``
+        — the elastic runtime's helper idiom), which is chased through
+        every project call site of the spawn helper.
+        """
+        out = []
+        for fn in self.functions:
+            for call in body_calls(fn.node):
+                if terminal_name(call.func) != "Thread":
+                    continue
+                target = None
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                if target is None and call.args:
+                    # threading.Thread(group, target, ...) positional form
+                    if len(call.args) >= 2:
+                        target = call.args[1]
+                if target is None:
+                    continue
+                for resolved in self._resolve_thread_target(fn, target):
+                    out.append((fn, resolved, call))
+        return out
+
+    def _resolve_thread_target(
+        self, spawner: FunctionInfo, target: ast.AST
+    ) -> List[FunctionInfo]:
+        direct = self.resolve_callable_ref(spawner, target)
+        if direct:
+            return direct
+        # target is a parameter of the spawn helper: chase the helper's
+        # call sites and resolve what each caller passed for it
+        if not isinstance(target, ast.Name):
+            return []
+        param_idx = _param_index(spawner.node, target.id)
+        if param_idx is None:
+            return []
+        resolved: List[FunctionInfo] = []
+        for caller in self.functions:
+            for call in body_calls(caller.node):
+                if spawner not in self.resolve_call(caller, call):
+                    continue
+                arg = _argument_for(spawner.node, call, param_idx, target.id)
+                if arg is not None:
+                    resolved.extend(self.resolve_callable_ref(caller, arg))
+        return resolved
+
+
+#: one-run memo: every project-scope analysis in a single lint_paths run
+#: receives the IDENTICAL modules list, so the graph is built once and
+#: shared.  The cached graph strongly references its modules, so the
+#: id-tuple key cannot be reused while the entry is alive; keeping only
+#: the latest entry bounds memory across test runs.
+_last_graph: Optional[Tuple[Tuple[int, ...], ProjectCallGraph]] = None
+
+
+def shared_graph(modules: Sequence[ModuleInfo]) -> ProjectCallGraph:
+    global _last_graph
+    key = tuple(id(m) for m in modules)
+    if _last_graph is not None and _last_graph[0] == key:
+        return _last_graph[1]
+    graph = ProjectCallGraph(modules)
+    _last_graph = (key, graph)
+    return graph
+
+
+def _param_index(fn: ast.AST, name: str) -> Optional[int]:
+    """Positional index of parameter ``name`` (``self``/``cls`` excluded
+    from the caller-side count), or None when it isn't a parameter."""
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    skip = 1 if pos and pos[0] in ("self", "cls") else 0
+    if name in pos:
+        return pos.index(name) - skip
+    if name in [p.arg for p in a.kwonlyargs]:
+        return -1  # keyword-only: matched by name below
+    return None
+
+
+def _argument_for(
+    fn: ast.AST, call: ast.Call, param_idx: int, param_name: str
+) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == param_name:
+            return kw.value
+    if 0 <= param_idx < len(call.args):
+        return call.args[param_idx]
+    return None

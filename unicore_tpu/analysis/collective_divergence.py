@@ -1,0 +1,250 @@
+"""collective-divergence: rank-conditional control flow that strands a
+host collective on one side of the branch.
+
+The deadliest multi-host failure class this repo knows: two ranks reach
+host collectives in different orders (or one rank never reaches one), and
+nothing fails until the collective watchdog dumps stacks half an hour
+later.  PR 2's runtime guard can only diagnose the hang after the fact;
+this analysis refuses the PATTERN at lint time — any path where a
+rank-/process_index-conditional branch reaches a host collective (a
+``distributed.utils`` wrapper, ``guard.run_collective``, a raw
+``multihost_utils`` entry point, or a KV ``wait_at_barrier``) on exactly
+ONE side of the branch.
+
+Both branch shapes that occur in practice are modeled:
+
+* one-sided arms — ``if rank == 0: broadcast_object(meta)``;
+* guard clauses — ``if rank != 0: return`` followed by a collective later
+  in the same block (the arm that exits never reaches it).
+
+Reachability is transitive over the :mod:`~unicore_tpu.analysis.callgraph`
+(the collective is usually 2-3 frames below the branch), with the usual
+name-resolution over-approximation.  Device-side collectives
+(``jax.lax.psum``/``all_to_all`` inside shard_map bodies) are NOT host
+collectives and are excluded — inside SPMD code, per-``axis_index``
+branching is the normal idiom and XLA keeps it coherent.
+
+Sanctioned rank-scoped paths — the checkpoint-writer guard, master-only
+logging that ends in a broadcast — carry an auditable
+``# lint: rank-scoped`` escape on the branch line (the stale-escape audit
+verifies each one still suppresses a real finding).
+"""
+
+import ast
+from typing import Iterator, List, Optional, Sequence
+
+from unicore_tpu.analysis.core import (
+    LintRule,
+    ModuleInfo,
+    Violation,
+    dotted_name,
+    register_lint_rule,
+    terminal_name,
+)
+from unicore_tpu.analysis.callgraph import shared_graph
+from unicore_tpu.analysis import dataflow
+
+#: host-side collective entry points (wrappers + the raw primitives they
+#: bottom out in).  ``all_to_all``/``all_gather`` also exist on jax.lax as
+#: DEVICE collectives — those are excluded by the ``.lax.`` base check.
+_COLLECTIVE_NAMES = frozenset(
+    {
+        "all_reduce",
+        "all_gather_list",
+        "all_reduce_dict",
+        "all_to_all",
+        "broadcast_tensors",
+        "broadcast_object",
+        "barrier",
+        "run_collective",
+        "process_allgather",
+        "broadcast_one_to_all",
+        "sync_global_devices",
+        "wait_at_barrier",
+    }
+)
+
+#: call shapes whose result is this process's rank (branching on them
+#: diverges control flow across hosts)
+_RANK_FUNCS = frozenset(
+    {
+        "process_index",
+        "get_global_rank",
+        "get_data_parallel_rank",
+        "get_rank",
+        "is_master",
+        "is_data_parallel_master",
+    }
+)
+
+#: attribute/name spellings of a rank value
+_RANK_ATTRS = frozenset({"distributed_rank", "process_index", "rank"})
+_RANK_NAMES = frozenset({"rank", "local_rank", "distributed_rank"})
+
+
+def is_collective_call(call: ast.Call) -> bool:
+    name = terminal_name(call.func)
+    if name not in _COLLECTIVE_NAMES:
+        return False
+    dotted = dotted_name(call.func)
+    if dotted and ".lax." in f".{dotted}":
+        return False  # jax.lax.all_to_all & co: device-side SPMD
+    return True
+
+
+def rank_condition(test: ast.AST) -> Optional[str]:
+    """Human-readable description of the rank read in ``test``, or None
+    when the branch cannot diverge across hosts."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = terminal_name(node.func)
+            if name in _RANK_FUNCS:
+                return f"{name}()"
+        elif isinstance(node, ast.Attribute) and node.attr in _RANK_ATTRS:
+            return f".{node.attr}"
+        elif isinstance(node, ast.Name) and node.id in _RANK_NAMES:
+            return node.id
+    return None
+
+
+def _is_terminal(stmts: Sequence[ast.stmt]) -> bool:
+    """Does this arm EXIT the enclosing block (return/raise/continue/
+    break as its last statement)?  Its peers then run the block's tail
+    without it — the guard-clause shape."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+@register_lint_rule("collective-divergence")
+class CollectiveDivergence(LintRule):
+    name = "collective-divergence"
+    scope = "project"
+    justifications = ("rank-scoped",)
+    description = (
+        "rank-conditional branch (process_index/get_rank/is_master/rank "
+        "compare) reaching a host collective on exactly one side: the "
+        "ranks taking the branch enter the collective, the others never "
+        "do — a guaranteed cross-host hang the watchdog can only diagnose "
+        "after --collective-timeout.  Hoist the collective out of the "
+        "branch, or justify a sanctioned rank-scoped path with "
+        "'# lint: rank-scoped'"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Violation]:
+        graph = shared_graph(modules)
+        summaries = dataflow.reaching_name_sets(
+            graph,
+            lambda fn, call: terminal_name(call.func)
+            if is_collective_call(call)
+            else None,
+        )
+
+        for fn in graph.functions:
+            yield from self._scan_block(
+                graph, summaries, fn, list(_own_body(fn.node))
+            )
+
+    # -- per-block scan ----------------------------------------------------
+
+    def _scan_block(self, graph, summaries, fn, stmts) -> Iterator[Violation]:
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, ast.If):
+                cond = rank_condition(stmt.test)
+                if cond is not None:
+                    v = self._judge(
+                        graph, summaries, fn, stmt, cond, stmts[i + 1:]
+                    )
+                    if v is not None:
+                        yield v
+            for block in _child_blocks(stmt):
+                yield from self._scan_block(graph, summaries, fn, block)
+
+    def _arm_names(self, graph, summaries, fn, stmts) -> frozenset:
+        """Names of every host collective this arm can reach — directly
+        or through any resolved callee's summary."""
+        names = set()
+        for stmt in stmts:
+            for node in dataflow.walk_arm(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if is_collective_call(node):
+                    names.add(terminal_name(node.func))
+                for callee in graph.resolve_call(fn, node):
+                    names |= summaries.get(callee, frozenset())
+        return frozenset(names)
+
+    def _judge(self, graph, summaries, fn, stmt, cond, rest):
+        def arm_names(arm_stmts):
+            return self._arm_names(graph, summaries, fn, arm_stmts)
+
+        body_names = arm_names(stmt.body)
+        else_names = arm_names(stmt.orelse)
+        # a terminal arm exits the block: its peers run the block tail
+        # WITHOUT it, so the tail joins the opposite side of the compare
+        rest_names = frozenset()
+        if _is_terminal(stmt.body) or _is_terminal(stmt.orelse):
+            rest_names = arm_names(rest)
+        taken = body_names
+        other = else_names
+        if _is_terminal(stmt.body):
+            other = else_names | rest_names
+        elif _is_terminal(stmt.orelse):
+            taken = body_names | rest_names
+
+        if not taken and not other:
+            return None
+        if bool(taken) != bool(other):
+            sites = ", ".join(sorted(taken or other))
+            side = "taken" if taken else "non-taken"
+            return self._v(
+                fn,
+                stmt,
+                f"rank-conditional branch on {cond} in '{fn.name}' "
+                f"reaches host collective(s) {sites} on the {side} side "
+                "only: ranks on the other side never enter — a "
+                "cross-host hang.  Hoist the collective out of the "
+                "branch or justify with '# lint: rank-scoped'",
+            )
+        if taken != other:
+            # both sides collect, but DIFFERENT collectives: the ranks
+            # pair mismatched collectives across hosts — the reorder
+            # variant of the same hang
+            return self._v(
+                fn,
+                stmt,
+                f"rank-conditional branch on {cond} in '{fn.name}' "
+                "reaches DIFFERENT host collectives per side (taken: "
+                f"{', '.join(sorted(taken))}; other: "
+                f"{', '.join(sorted(other))}): the ranks pair mismatched "
+                "collectives across hosts — a cross-host hang or silent "
+                "payload crossover.  Make both sides run the same "
+                "collective sequence or justify with '# lint: rank-scoped'",
+            )
+        return None
+
+    def _v(self, fn, stmt, msg):
+        return Violation(
+            self.name, fn.module.path, stmt.lineno, stmt.col_offset, msg
+        )
+
+
+def _own_body(fn: ast.AST) -> List[ast.stmt]:
+    return list(fn.body)
+
+
+def _child_blocks(stmt: ast.AST) -> Iterator[List[ast.stmt]]:
+    """Nested statement lists of one statement, skipping def/class scopes
+    (they are scanned as their own functions)."""
+    if isinstance(
+        stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ):
+        return
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if block:
+            yield list(block)
+    for handler in getattr(stmt, "handlers", ()) or ():
+        yield list(handler.body)

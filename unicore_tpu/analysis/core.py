@@ -34,9 +34,13 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
 from unicore_tpu.registry import Registry
 
-# Suppression comments: ``# lint: <token>[, <token>...]`` on the violating
-# line or the line directly above silences any rule whose name — or one of
-# whose declared ``justifications`` — matches a token.
+# Suppression comments: a comment whose body starts with ``lint:
+# <token>[, <token>...]``, on the violating line or the line directly
+# above, silences any rule whose name — or one of whose declared
+# ``justifications`` — matches a token.  The comment body must START
+# with the marker (prose mentioning it mid-sentence is not an escape),
+# so the exact set of comments that can suppress is the set the
+# stale-escape audit verifies.
 _LINT_COMMENT_PREFIX = "lint:"
 
 
@@ -172,27 +176,47 @@ class ModuleInfo:
             self._traced = TracedIndex(self)
         return self._traced
 
-    def suppression_tokens(self, line: int) -> Set[str]:
-        """Tokens from ``# lint: ...`` comments on ``line`` or ``line-1``."""
+    def tokens_at(self, line: int) -> Set[str]:
+        """Tokens of the escape annotation on exactly ``line`` — a
+        comment whose body STARTS with ``lint:``.  Prose comments that
+        merely mention ``lint:`` mid-sentence are not annotations; the
+        SAME definition serves suppression and the stale-escape audit, so
+        everything that can suppress is auditable and vice versa."""
+        comment = self.comments.get(line, "")
+        body = comment.lstrip("#").lstrip()
+        if not body.startswith(_LINT_COMMENT_PREFIX):
+            return set()
         tokens: Set[str] = set()
-        for ln in (line, line - 1):
-            comment = self.comments.get(ln, "")
-            idx = comment.find(_LINT_COMMENT_PREFIX)
-            if idx < 0:
-                continue
-            body = comment[idx + len(_LINT_COMMENT_PREFIX):]
-            for tok in body.replace(";", ",").split(","):
-                tok = tok.strip()
-                if tok:
-                    tokens.add(tok)
+        for tok in body[len(_LINT_COMMENT_PREFIX):].replace(
+            ";", ","
+        ).split(","):
+            tok = tok.strip()
+            if tok:
+                tokens.add(tok)
         return tokens
 
-    def is_suppressed(self, violation: Violation, rule: LintRule) -> bool:
-        tokens = self.suppression_tokens(violation.line)
-        if not tokens:
-            return False
+    def escape_lines(self) -> Dict[int, Set[str]]:
+        """Every escape-annotation line mapped to its tokens."""
+        out: Dict[int, Set[str]] = {}
+        for line in self.comments:
+            tokens = self.tokens_at(line)
+            if tokens:
+                out[line] = tokens
+        return out
+
+    def matching_escape(
+        self, violation: Violation, rule: LintRule
+    ) -> Optional[int]:
+        """The comment LINE whose tokens suppress ``violation`` under
+        ``rule`` (the violating line, or the line above), else None."""
         accepted = {rule.name, *rule.justifications}
-        return bool(tokens & accepted)
+        for ln in (violation.line, violation.line - 1):
+            if self.tokens_at(ln) & accepted:
+                return ln
+        return None
+
+    def is_suppressed(self, violation: Violation, rule: LintRule) -> bool:
+        return self.matching_escape(violation, rule) is not None
 
 
 def _comment_map(source: str) -> Dict[int, str]:
@@ -232,6 +256,10 @@ def build_rules(select: Optional[Sequence[str]] = None) -> List[LintRule]:
     # importing the rule modules populates the registry
     import unicore_tpu.analysis.dead_flags  # noqa: F401
     import unicore_tpu.analysis.rules  # noqa: F401
+    import unicore_tpu.analysis.collective_divergence  # noqa: F401
+    import unicore_tpu.analysis.sharding_legality  # noqa: F401
+    import unicore_tpu.analysis.shared_state  # noqa: F401
+    import unicore_tpu.analysis.escapes  # noqa: F401
 
     names = list(LINT_RULE_REGISTRY.classes)
     if select is not None:
@@ -268,16 +296,33 @@ def lint_paths(
             )
 
     by_path = {m.path: m for m in modules}
+    #: escape-comment lines that suppressed at least one finding —
+    #: consumed by the stale-escape audit ("every escape is auditable")
+    used_escapes: Set = set()
+    audit_rules = []
     for rule in rules:
+        if getattr(rule, "audits_escapes", False):
+            audit_rules.append(rule)  # runs last: needs the full ledger
+            continue
         if rule.scope == "project":
             found = rule.check_project(modules)
         else:
             found = (v for m in modules for v in rule.check(m))
         for v in found:
             mod = by_path.get(v.path)
-            if mod is not None and mod.is_suppressed(v, rule):
-                continue
+            if mod is not None:
+                line = mod.matching_escape(v, rule)
+                if line is not None:
+                    used_escapes.add((v.path, line))
+                    continue
             violations.append(v)
+
+    for rule in audit_rules:
+        # audit findings are NOT suppressible: they land on the escape
+        # comment itself, so honoring a '# lint: stale-lint-escape' token
+        # there would let any rotten escape self-suppress its own audit —
+        # the exact rot class the audit exists to catch
+        violations.extend(rule.check_escapes(modules, used_escapes, rules))
 
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return violations

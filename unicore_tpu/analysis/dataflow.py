@@ -1,0 +1,126 @@
+"""Forward dataflow over the project call graph.
+
+The whole-program analyses all reduce to the same fixpoint shape: a
+per-function summary fact, seeded at functions whose BODY exhibits a
+property directly, propagated along call edges until stable.  This module
+owns that engine so each analysis states only its seed and its join:
+
+* :func:`function_summaries` — generic monotone fixpoint: callee facts
+  flow INTO their callers ("my body does X, or something I call does X"),
+  which is the summary direction every current client needs (can this
+  function reach a collective?  can the Thread target reach this write?).
+* :func:`reaching_functions` — the common boolean instance: the set of
+  functions from which a call matching ``predicate`` is reachable, plus a
+  witness call site per seed function (for diagnostics);
+* :func:`reaching_name_sets` — the set-valued refinement: WHICH matching
+  sites each function can reach, for rules that must compare two paths'
+  site sets rather than mere reachability.
+
+Facts must form a join-semilattice with ``bottom`` and a monotone
+``join``; booleans-with-or are the workhorse.  Termination: facts only
+grow, the graph is finite, iteration is round-robin until no change.
+"""
+
+from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
+
+import ast
+
+from unicore_tpu.analysis.callgraph import (
+    FunctionInfo,
+    ProjectCallGraph,
+    body_calls,
+)
+
+
+def function_summaries(
+    graph: ProjectCallGraph,
+    seed: Callable[[FunctionInfo], Any],
+    join: Callable[[Any, Any], Any],
+    bottom: Any = False,
+) -> Dict[FunctionInfo, Any]:
+    """Least fixpoint of ``fact[f] = seed(f) ⊔ ⊔{fact[g] : f calls g}``.
+
+    ``seed(f)`` states what ``f``'s own body contributes; ``join`` merges
+    facts (monotone, associative).  Callee facts propagate to callers, so
+    the result answers "does anything REACHABLE from f satisfy the seed".
+    """
+    facts: Dict[FunctionInfo, Any] = {
+        fn: seed(fn) for fn in graph.functions
+    }
+    # reverse edges once: callee -> callers (the propagation direction)
+    callers: Dict[FunctionInfo, Set[FunctionInfo]] = {}
+    for fn in graph.functions:
+        for call in body_calls(fn.node):
+            for callee in graph.resolve_call(fn, call):
+                callers.setdefault(callee, set()).add(fn)
+
+    work = [fn for fn in graph.functions if facts[fn] != bottom]
+    while work:
+        fn = work.pop()
+        fact = facts[fn]
+        for caller in callers.get(fn, ()):
+            merged = join(facts[caller], fact)
+            if merged != facts[caller]:
+                facts[caller] = merged
+                work.append(caller)
+    return facts
+
+
+def reaching_functions(
+    graph: ProjectCallGraph,
+    predicate: Callable[[FunctionInfo, ast.Call], bool],
+) -> Tuple[Set[FunctionInfo], Dict[FunctionInfo, ast.Call]]:
+    """Functions from which a call matching ``predicate`` is reachable.
+
+    Returns ``(reaching, witness)``: ``witness[f]`` is the first matching
+    call in ``f``'s OWN body (only seed functions carry one — transitive
+    reachers point at their callee chain instead).
+    """
+    witness: Dict[FunctionInfo, ast.Call] = {}
+
+    def seed(fn: FunctionInfo) -> bool:
+        for call in body_calls(fn.node):
+            if predicate(fn, call):
+                witness.setdefault(fn, call)
+                return True
+        return False
+
+    facts = function_summaries(graph, seed, lambda a, b: a or b, False)
+    return {fn for fn, hit in facts.items() if hit}, witness
+
+
+def reaching_name_sets(
+    graph: ProjectCallGraph,
+    name_of: Callable[[FunctionInfo, ast.Call], Optional[str]],
+) -> Dict[FunctionInfo, frozenset]:
+    """Per-function summary: the NAMES of all matching calls reachable
+    from each function (``name_of`` returns a label for a matching call,
+    None otherwise).  The set-valued refinement of
+    :func:`reaching_functions` — rules that must compare WHICH sites two
+    paths reach (not just whether they reach any) consume this."""
+
+    def seed(fn: FunctionInfo) -> frozenset:
+        names = set()
+        for call in body_calls(fn.node):
+            label = name_of(fn, call)
+            if label is not None:
+                names.add(label)
+        return frozenset(names)
+
+    return function_summaries(
+        graph, seed, lambda a, b: a | b, frozenset()
+    )
+
+
+def walk_arm(stmt: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of one statement (one slice of a branch arm), skipping
+    nested def/class scopes — they don't execute when the arm runs."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

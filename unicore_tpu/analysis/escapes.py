@@ -1,0 +1,104 @@
+"""stale-lint-escape: every ``# lint: <token>`` annotation must still
+suppress a real finding.
+
+Escape comments are this linter's accountability mechanism — each one is
+a signed waiver for ONE specific finding.  They rot three ways: the rule
+gets renamed (the token no longer matches anything), the code gets fixed
+(nothing left to suppress), or an edit drifts the annotation off the
+violating line.  A rotten escape is worse than none: it reads as a
+justified exception while suppressing nothing — and would silently
+re-arm if the violation ever came back one line away.
+
+The audit rides the lint driver itself: ``lint_paths`` records which
+escape-comment lines actually absorbed a finding during the run, and this
+rule flags every annotation line that absorbed none.  Tokens are
+classified so the diagnosis names the rot:
+
+* token unknown to EVERY registered rule → renamed rule or typo;
+* token owned by a rule that RAN and found nothing here → fixed code or
+  drifted annotation;
+* token owned only by rules excluded via ``--select`` → skipped (this
+  run cannot judge it), so a partial-rule run never mass-flags escapes.
+
+The audit only inspects comments whose body STARTS with ``lint:`` —
+prose that mentions the marker mid-sentence is not an annotation.
+"""
+
+from typing import Iterator, Sequence, Set, Tuple
+
+from unicore_tpu.analysis.core import (
+    LINT_RULE_REGISTRY,
+    LintRule,
+    ModuleInfo,
+    Violation,
+    register_lint_rule,
+)
+
+
+def _registered_tokens() -> Set[str]:
+    tokens: Set[str] = set()
+    for name, cls in LINT_RULE_REGISTRY.classes.items():
+        tokens.add(name)
+        tokens.update(getattr(cls, "justifications", ()))
+    return tokens
+
+
+@register_lint_rule("stale-lint-escape")
+class StaleLintEscape(LintRule):
+    name = "stale-lint-escape"
+    scope = "project"
+    #: lint_paths runs this AFTER every other rule, against the ledger of
+    #: escape lines that suppressed at least one finding
+    audits_escapes = True
+    description = (
+        "a '# lint: <token>' escape annotation that no longer suppresses "
+        "any finding: the rule was renamed, the code was fixed, or the "
+        "annotation drifted off the violating line — remove it (a rotten "
+        "escape reads as a justified exception while waiving nothing).  "
+        "Audit findings are themselves NOT suppressible: a "
+        "'stale-lint-escape' token on the escape line would let any "
+        "rotten escape self-suppress its own audit"
+    )
+
+    def check_escapes(
+        self,
+        modules: Sequence[ModuleInfo],
+        used: Set[Tuple[str, int]],
+        active_rules: Sequence[LintRule],
+    ) -> Iterator[Violation]:
+        registered = _registered_tokens()
+        active_tokens: Set[str] = set()
+        for rule in active_rules:
+            active_tokens.add(rule.name)
+            active_tokens.update(rule.justifications)
+        for module in modules:
+            for line, tokens in sorted(module.escape_lines().items()):
+                if (module.path, line) in used:
+                    continue
+                unknown = sorted(tokens - registered)
+                if unknown:
+                    yield Violation(
+                        self.name,
+                        module.path,
+                        line,
+                        0,
+                        f"escape token(s) {', '.join(unknown)} match no "
+                        "registered rule or justification: the rule was "
+                        "renamed or the token is a typo — this annotation "
+                        "suppresses NOTHING",
+                    )
+                    continue
+                if not (tokens & active_tokens):
+                    # owned only by rules excluded from this run: a
+                    # partial --select run cannot judge the escape
+                    continue
+                yield Violation(
+                    self.name,
+                    module.path,
+                    line,
+                    0,
+                    f"stale escape '# lint: {', '.join(sorted(tokens))}': "
+                    "no active rule reports a finding on this line — the "
+                    "code was fixed or the annotation drifted; remove it "
+                    "(it would silently re-arm if the violation returned)",
+                )

@@ -494,8 +494,9 @@ class UnsafeShardMap(LintRule):
     name = "unsafe-shard-map"
     justifications = ("jax-version-pinned",)
     description = (
-        "shard_map with check_vma=False (varying-across-mesh checking "
-        "disabled) or an empty axis_names=frozenset() (implicit "
+        "shard_map with replication checking disabled (check_vma=False "
+        "on the vma-typed API, check_rep=False on the 0.4.x experimental "
+        "API) or an empty axis_names=frozenset() (implicit "
         "all-axes-manual) without a '# lint: jax-version-pinned' "
         "justification comment"
     )
@@ -508,7 +509,7 @@ class UnsafeShardMap(LintRule):
                 continue
             for kw in node.keywords:
                 if (
-                    kw.arg == "check_vma"
+                    kw.arg in ("check_vma", "check_rep")
                     and isinstance(kw.value, ast.Constant)
                     and kw.value.value is False
                 ):
@@ -516,9 +517,9 @@ class UnsafeShardMap(LintRule):
                         self,
                         module,
                         kw.value,
-                        "shard_map(check_vma=False) disables varying-"
-                        "across-mesh checking; justify the pin with "
-                        "'# lint: jax-version-pinned' or re-enable it",
+                        f"shard_map({kw.arg}=False) disables replication/"
+                        "varying-across-mesh checking; justify the pin "
+                        "with '# lint: jax-version-pinned' or re-enable it",
                     )
                 elif (
                     kw.arg == "axis_names"

@@ -1,0 +1,105 @@
+"""SARIF 2.1.0 serialization for lint findings.
+
+GitHub code scanning ingests SARIF and renders each result as an inline
+annotation on the PR diff — so ``unicore-tpu-lint --format sarif`` turns
+the CI gate's wall of ``path:line:col`` text into reviewable, per-line
+findings.  The emitter targets the minimum schema code scanning needs:
+one run, one driver, per-rule metadata (id + description), and one result
+per violation with a physical location.  Columns are converted from the
+linter's 0-based ``ast`` offsets to SARIF's 1-based convention; paths are
+emitted with forward slashes relative to the invocation directory, which
+is what the upload action expects.
+"""
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from unicore_tpu.analysis.core import LintRule, Violation
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+_TOOL_URI = "https://github.com/dptech-corp/Uni-Core"
+
+
+def _artifact_uri(path: str) -> str:
+    """CWD-relative URI (what the upload action resolves against
+    %SRCROOT% when CI lints from the repo root); a path OUTSIDE the
+    invocation directory keeps its original form — a '../'-prefixed URI
+    escapes the source root and code scanning would drop the finding."""
+    rel = os.path.relpath(path)
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def to_sarif(
+    violations: Sequence[Violation],
+    rules: Optional[Sequence[LintRule]] = None,
+) -> Dict:
+    """One SARIF ``log`` dict for the given findings.
+
+    ``rules`` seeds the driver's rule table (so a clean run still
+    publishes the rule inventory); rule ids that appear only in findings
+    (e.g. the driver-synthesized ``parse-error``) are appended on demand.
+    """
+    rule_table: List[Dict] = []
+    rule_index: Dict[str, int] = {}
+
+    def ensure_rule(rule_id: str, description: str = "") -> int:
+        if rule_id in rule_index:
+            return rule_index[rule_id]
+        rule_index[rule_id] = len(rule_table)
+        entry: Dict = {"id": rule_id}
+        if description:
+            entry["shortDescription"] = {"text": description}
+        rule_table.append(entry)
+        return rule_index[rule_id]
+
+    for rule in rules or ():
+        ensure_rule(rule.name, rule.description)
+    ensure_rule("parse-error", "file could not be parsed or decoded")
+
+    results = []
+    for v in violations:
+        results.append(
+            {
+                "ruleId": v.rule,
+                "ruleIndex": ensure_rule(v.rule),
+                "level": "error",
+                "message": {"text": v.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": _artifact_uri(v.path),
+                                "uriBaseId": "%SRCROOT%",
+                            },
+                            "region": {
+                                "startLine": max(1, v.line),
+                                "startColumn": v.col + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "unicore-tpu-lint",
+                        "informationUri": _TOOL_URI,
+                        "rules": rule_table,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }

@@ -1,0 +1,326 @@
+"""sharding-legality: axis names at sharding call sites checked against
+the mesh declaration.
+
+``parallel/mesh.py`` is the single source of truth for every parallelism
+axis (ROADMAP item 1's declarative plan config); XLA, however, learns an
+axis name only at run time — a ``PartitionSpec("modle")`` typo, a ``psum``
+over an axis the mesh never declared, or an ``in_specs`` tuple that
+doesn't match the wrapped function's signature all surface as opaque
+runtime errors deep inside jit.  This analysis is the static half: it
+reads the axis declaration out of the linted ``mesh.py`` (the module-level
+``*_AXIS = "name"`` constants and the ``ALL_AXES`` tuple / ``Mesh(...)``
+axis-name argument) and checks every sharding call site in the lint set:
+
+* **undeclared-axis** — a resolvable axis name (string literal, a
+  ``*_AXIS`` constant imported from mesh.py, or a local string constant)
+  used in ``PartitionSpec``/``P(...)``, a ``jax.lax`` named collective
+  (``psum``/``pmean``/``all_gather``/``all_to_all``/``ppermute``/
+  ``axis_index``/...), or a ``shard_map`` ``auto=`` set, that the mesh
+  never declares;
+* **reused-axis** — the same mesh axis appearing twice in ONE
+  PartitionSpec (an axis can shard at most one dimension);
+* **rank-mismatch** — a ``shard_map`` call whose literal ``in_specs``
+  tuple length differs from the wrapped local function's positional
+  signature (specs and arguments pair positionally; a mismatch is a
+  guaranteed tree-structure error at trace time).
+
+Axis names that cannot be resolved statically (parameters, computed
+strings) are skipped — zero-noise bias, same trade as every other rule.
+When no ``mesh.py`` is in the lint set the rule is inert (there is no
+declaration to check against).
+"""
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from unicore_tpu.analysis.core import (
+    LintRule,
+    ModuleInfo,
+    Violation,
+    dotted_name,
+    register_lint_rule,
+    terminal_name,
+)
+
+#: jax.lax collectives/queries whose axis-name argument must be a mesh
+#: axis: (terminal name, positional index of the axis argument)
+_AXIS_CALLS: Dict[str, int] = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "axis_index": 0,
+}
+#: calls that take the axis via ``axis_name=`` at varying positions
+_AXIS_KWARG_CALLS = frozenset(
+    {"all_to_all", "all_gather", "psum", "pmean", "pmax", "pmin"}
+)
+
+
+def _mesh_declaration(modules: Sequence[ModuleInfo]):
+    """``(mesh module, axis constants {NAME: value}, declared axis set)``
+    from the first ``mesh.py`` in the lint set, else ``(None, {}, set())``."""
+    for module in modules:
+        if os.path.basename(os.path.normpath(module.path)) != "mesh.py":
+            continue
+        constants: Dict[str, str] = {}
+        declared: Set[str] = set()
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                value = node.value
+                if isinstance(value, ast.Constant) and isinstance(
+                    value.value, str
+                ):
+                    constants[target.id] = value.value
+                elif target.id == "ALL_AXES" and isinstance(
+                    value, (ast.Tuple, ast.List)
+                ):
+                    for el in value.elts:
+                        name = _axis_literal(el, constants)
+                        if name is not None:
+                            declared.add(name)
+        # Mesh(devices, (axis, names, ...)) declarations (fixture meshes
+        # and make_mesh itself) count too
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "Mesh" or len(node.args) < 2:
+                continue
+            names_arg = node.args[1]
+            if isinstance(names_arg, (ast.Tuple, ast.List)):
+                for el in names_arg.elts:
+                    name = _axis_literal(el, constants)
+                    if name is not None:
+                        declared.add(name)
+        if not declared:
+            declared = set(constants.values())
+        return module, constants, declared
+    return None, {}, set()
+
+
+def _axis_literal(
+    node: ast.AST, constants: Dict[str, str]
+) -> Optional[str]:
+    """Resolve one axis-name expression to a string, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    if isinstance(node, ast.Attribute):
+        return constants.get(node.attr)
+    return None
+
+
+class _ModuleEnv:
+    """Per-module name environment for resolving axis expressions."""
+
+    def __init__(self, module: ModuleInfo, mesh_constants: Dict[str, str]):
+        self.constants: Dict[str, str] = {}
+        self.pspec_names: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module.rsplit(".", 1)[-1]
+                for a in node.names:
+                    local = a.asname or a.name
+                    if a.name == "PartitionSpec" and "sharding" in node.module:
+                        self.pspec_names.add(local)
+                    if base == "mesh" and a.name in mesh_constants:
+                        self.constants[local] = mesh_constants[a.name]
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    # module- or function-level NAME = "axis" aliases
+                    self.constants.setdefault(t.id, node.value.value)
+        # mesh.py's own constants resolve in mesh.py itself; any module
+        # may also reference them via a `mesh.` attribute, handled by
+        # falling back to attr-name lookup in resolve()
+        self._mesh_constants = mesh_constants
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in self.constants:
+                return self.constants[node.id]
+            return self._mesh_constants.get(node.id)
+        if isinstance(node, ast.Attribute):
+            return self._mesh_constants.get(node.attr)
+        return None
+
+
+@register_lint_rule("sharding-legality")
+class ShardingLegality(LintRule):
+    name = "sharding-legality"
+    scope = "project"
+    description = (
+        "axis names at PartitionSpec/shard_map/psum call sites checked "
+        "against the mesh axes declared in parallel/mesh.py: undeclared "
+        "axis (typo or missing mesh declaration), axis reused within one "
+        "PartitionSpec, and shard_map in_specs whose arity doesn't match "
+        "the wrapped function's signature — each a guaranteed opaque "
+        "runtime error inside jit"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Violation]:
+        mesh_module, constants, declared = _mesh_declaration(modules)
+        if mesh_module is None or not declared:
+            return
+        for module in modules:
+            env = _ModuleEnv(module, constants)
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = terminal_name(node.func)
+                if name in env.pspec_names or name == "PartitionSpec":
+                    yield from self._check_pspec(module, env, declared, node)
+                elif name in _AXIS_CALLS or name in _AXIS_KWARG_CALLS:
+                    yield from self._check_axis_call(
+                        module, env, declared, node, name
+                    )
+                elif name == "shard_map":
+                    yield from self._check_shard_map(
+                        module, env, declared, node
+                    )
+
+    # -- PartitionSpec(...) ------------------------------------------------
+
+    def _check_pspec(self, module, env, declared, call) -> Iterator[Violation]:
+        seen: Dict[str, ast.AST] = {}
+        for arg in call.args:
+            entries = (
+                list(arg.elts)
+                if isinstance(arg, (ast.Tuple, ast.List))
+                else [arg]
+            )
+            for el in entries:
+                axis = env.resolve(el)
+                if axis is None:
+                    continue
+                if axis not in declared:
+                    yield self._v(
+                        module,
+                        el,
+                        f"PartitionSpec names axis '{axis}', which the mesh "
+                        f"never declares (mesh axes: "
+                        f"{', '.join(sorted(declared))}) — a typo here is "
+                        "an opaque XLA error at jit time",
+                    )
+                elif axis in seen:
+                    yield self._v(
+                        module,
+                        el,
+                        f"PartitionSpec reuses axis '{axis}' for a second "
+                        "dimension: one mesh axis can shard at most one "
+                        "dimension of an array",
+                    )
+                seen.setdefault(axis, el)
+
+    # -- jax.lax named collectives ----------------------------------------
+
+    def _check_axis_call(
+        self, module, env, declared, call, name
+    ) -> Iterator[Violation]:
+        axis_args: List[ast.AST] = []
+        pos = _AXIS_CALLS.get(name)
+        if pos is not None and len(call.args) > pos:
+            axis_args.append(call.args[pos])
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_args.append(kw.value)
+        for arg in axis_args:
+            entries = (
+                list(arg.elts)
+                if isinstance(arg, (ast.Tuple, ast.List))
+                else [arg]
+            )
+            for el in entries:
+                axis = env.resolve(el)
+                if axis is not None and axis not in declared:
+                    yield self._v(
+                        module,
+                        el,
+                        f"{name}(...) names axis '{axis}', which the mesh "
+                        f"never declares (mesh axes: "
+                        f"{', '.join(sorted(declared))})",
+                    )
+
+    # -- shard_map ---------------------------------------------------------
+
+    def _check_shard_map(
+        self, module, env, declared, call
+    ) -> Iterator[Violation]:
+        in_specs = None
+        for kw in call.keywords:
+            if kw.arg in ("auto", "manual_axes") and isinstance(
+                kw.value, ast.Call
+            ):
+                inner = kw.value
+                if terminal_name(inner.func) == "frozenset" and inner.args:
+                    arg = inner.args[0]
+                    if isinstance(arg, (ast.Tuple, ast.List, ast.Set)):
+                        for el in arg.elts:
+                            axis = env.resolve(el)
+                            if axis is not None and axis not in declared:
+                                yield self._v(
+                                    module,
+                                    el,
+                                    f"shard_map {kw.arg}= names axis "
+                                    f"'{axis}', which the mesh never "
+                                    "declares",
+                                )
+            elif kw.arg == "in_specs" and isinstance(kw.value, ast.Tuple):
+                in_specs = kw.value
+        if in_specs is None or not call.args:
+            return
+        target = call.args[0]
+        fn_def = self._local_def(module, target)
+        if fn_def is None:
+            return
+        a = fn_def.args
+        if a.vararg is not None or a.kwarg is not None:
+            return  # *args absorbs any arity; nothing to check
+        n_params = len(a.posonlyargs) + len(a.args)
+        if a.args and a.args[0].arg in ("self", "cls"):
+            n_params -= 1
+        n_specs = len(in_specs.elts)
+        if n_specs != n_params:
+            yield self._v(
+                module,
+                in_specs,
+                f"shard_map in_specs carries {n_specs} spec(s) but "
+                f"'{fn_def.name}' takes {n_params} positional argument(s): "
+                "specs pair with arguments positionally, so this is a "
+                "guaranteed tree-structure error at trace time",
+            )
+
+    @staticmethod
+    def _local_def(module: ModuleInfo, target: ast.AST):
+        name = terminal_name(target)
+        if name is None:
+            return None
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name
+            ):
+                return node
+        return None
+
+    def _v(self, module: ModuleInfo, node: ast.AST, msg: str) -> Violation:
+        return Violation(
+            self.name, module.path, node.lineno, node.col_offset, msg
+        )

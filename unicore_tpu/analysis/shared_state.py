@@ -1,0 +1,262 @@
+"""unsynchronized-shared-state: cross-thread write/write races on shared
+fields.
+
+Eight modules in this tree spawn ``threading.Thread``\\ s (device
+prefetcher, buffered iterators, elastic heartbeats, the collective
+watchdog, serve engine/http/reload, the metrics exporter).  Each one
+hand-maintains the same discipline: fields touched by both the thread
+target's call graph AND the main loop go under a lock, everything else is
+single-writer.  Nothing checked that discipline until now — a field that
+drifts into both sides without a common lock is a silent data race that
+no test reliably catches.
+
+The audit, per spawning class (or module, for function targets):
+
+1. thread side = every function reachable from a ``Thread(target=...)``
+   target through the project call graph — including targets forwarded
+   through a spawn-helper parameter (``def _spawn(target): Thread(
+   target=target)``), the elastic runtime's idiom;
+2. main side = the class's other methods.  ``__init__`` and the spawning
+   function itself are EXCLUDED: construct-then-publish writes that
+   happen before ``.start()`` are the sanctioned initialization pattern;
+3. a WRITE is a plain rebinding (``self.x = ...``, ``+=``) of an
+   attribute (or, for module-level targets, of a ``global``-declared
+   name).  Method calls on a field (``q.put(...)``, ``evt.set()``) are
+   the field's own thread-safety contract and stay out of scope;
+4. a write is protected by the locks of every enclosing ``with self._lock:``
+   block; a field written on both sides where some thread-side write and
+   some main-side write share NO lock is flagged once per field.
+
+Deliberate lock-free fields — a monotonic stop flag read racily by
+design, a GIL-atomic counter — carry ``# lint: single-writer`` (or the
+rule name) on the write line, auditable by the stale-escape pass.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from unicore_tpu.analysis.core import (
+    LintRule,
+    ModuleInfo,
+    Violation,
+    register_lint_rule,
+    terminal_name,
+)
+from unicore_tpu.analysis.callgraph import (
+    FunctionInfo,
+    body_calls,
+    shared_graph,
+)
+
+
+class _Write:
+    __slots__ = ("attr", "fn", "node", "locks")
+
+    def __init__(self, attr: str, fn: FunctionInfo, node: ast.AST,
+                 locks: frozenset):
+        self.attr = attr
+        self.fn = fn
+        self.node = node
+        self.locks = locks
+
+
+def _collect_writes(fn: FunctionInfo, name_of_target, lock_of_with) -> List[_Write]:
+    """Rebinding writes in ``fn``'s own body, each tagged with the locks
+    of its enclosing ``with`` blocks.  One walker serves both audit
+    shapes — ``name_of_target`` extracts the written field's name (or
+    None to skip), ``lock_of_with`` names a held lock from a with-item's
+    context expression — so lock-context traversal can never drift
+    between the class-field and module-global halves of the rule."""
+    writes: List[_Write] = []
+
+    def walk(node, locks):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(node, ast.With):
+            held = set(locks)
+            for item in node.items:
+                lock = lock_of_with(item.context_expr)
+                if lock is not None:
+                    held.add(lock)
+            for child in node.body:
+                walk(child, frozenset(held))
+            return
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = (node.target,)
+        for t in targets:
+            for el in _flat_targets(t):
+                name = name_of_target(el)
+                if name is not None:
+                    writes.append(_Write(name, fn, node, locks))
+        for child in ast.iter_child_nodes(node):
+            walk(child, locks)
+
+    for stmt in fn.node.body:
+        walk(stmt, frozenset())
+    return writes
+
+
+def _attr_writes(fn: FunctionInfo) -> List[_Write]:
+    """``self.<attr>`` rebinding writes, locks = ``with self.<lock>:``."""
+    return _collect_writes(fn, _self_attr_name, _self_attr_name)
+
+
+def _global_writes(fn: FunctionInfo) -> List[_Write]:
+    """Writes to ``global``-declared names (module-level shared state);
+    locks = ``with <name>:`` on module-level lock objects."""
+    declared: Set[str] = set()
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Global):
+            declared.update(node.names)
+    if not declared:
+        return []
+    return _collect_writes(
+        fn,
+        lambda el: el.id
+        if isinstance(el, ast.Name) and el.id in declared
+        else None,
+        terminal_name,
+    )
+
+
+def _flat_targets(t: ast.AST):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            yield from _flat_targets(el)
+    elif isinstance(t, ast.Starred):
+        yield from _flat_targets(t.value)
+    else:
+        yield t
+
+
+def _self_attr_name(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return node.attr
+    return None
+
+
+@register_lint_rule("unsynchronized-shared-state")
+class UnsynchronizedSharedState(LintRule):
+    name = "unsynchronized-shared-state"
+    scope = "project"
+    justifications = ("single-writer",)
+    description = (
+        "a field written both by a threading.Thread target's call graph "
+        "and by the main loop with no common lock: a silent write/write "
+        "race no test reliably catches.  Guard both writes with one "
+        "'with self._lock:', or justify a deliberately lock-free field "
+        "(monotonic flag, GIL-atomic counter) with '# lint: single-writer'"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Violation]:
+        graph = shared_graph(modules)
+        roots = graph.thread_roots()
+        if not roots:
+            return
+
+        # group thread targets by their OWNER scope: a class for method
+        # targets, the module for function targets
+        class_targets: Dict[Tuple[str, str], List[FunctionInfo]] = {}
+        module_targets: Dict[str, List[FunctionInfo]] = {}
+        spawners: Set[FunctionInfo] = set()
+        for spawner, target, _call in roots:
+            spawners.add(spawner)
+            if target.class_name is not None:
+                class_targets.setdefault(
+                    (target.module.path, target.class_name), []
+                ).append(target)
+            else:
+                module_targets.setdefault(target.module.path, []).append(
+                    target
+                )
+
+        for (path, cls), targets in sorted(class_targets.items()):
+            yield from self._check_class(graph, path, cls, targets, spawners)
+        for path, targets in sorted(module_targets.items()):
+            yield from self._check_module(graph, path, targets, spawners)
+
+    # -- class-scoped thread targets --------------------------------------
+
+    def _check_class(self, graph, path, cls, targets, spawners):
+        methods = [
+            fn
+            for fn in graph.functions
+            if fn.module.path == path and fn.class_name == cls
+        ]
+        thread_side = graph.reachable(targets)
+        excluded = {
+            fn
+            for fn in methods
+            if fn.name in ("__init__", "__post_init__") or fn in spawners
+        }
+        thread_writes: Dict[str, List[_Write]] = {}
+        main_writes: Dict[str, List[_Write]] = {}
+        for fn in methods:
+            if fn in excluded:
+                continue
+            bucket = thread_writes if fn in thread_side else main_writes
+            for w in _attr_writes(fn):
+                bucket.setdefault(w.attr, []).append(w)
+        yield from self._judge(
+            f"{cls}", thread_writes, main_writes, targets
+        )
+
+    # -- module-scoped (function) thread targets ---------------------------
+
+    def _check_module(self, graph, path, targets, spawners):
+        funcs = [
+            fn
+            for fn in graph.functions
+            if fn.module.path == path and fn.class_name is None
+        ]
+        thread_side = graph.reachable(targets)
+        thread_writes: Dict[str, List[_Write]] = {}
+        main_writes: Dict[str, List[_Write]] = {}
+        for fn in funcs:
+            if fn in spawners and fn not in thread_side:
+                continue
+            bucket = thread_writes if fn in thread_side else main_writes
+            for w in _global_writes(fn):
+                bucket.setdefault(w.attr, []).append(w)
+        yield from self._judge(
+            f"module {path}", thread_writes, main_writes, targets
+        )
+
+    def _judge(self, owner, thread_writes, main_writes, targets):
+        target_names = ", ".join(sorted({t.name for t in targets}))
+        for attr in sorted(set(thread_writes) & set(main_writes)):
+            pair = self._unlocked_pair(thread_writes[attr], main_writes[attr])
+            if pair is None:
+                continue
+            tw, mw = pair
+            yield Violation(
+                self.name,
+                tw.fn.module.path,
+                tw.node.lineno,
+                tw.node.col_offset,
+                f"'{attr}' of {owner} is written by thread target "
+                f"'{target_names}' side ('{tw.fn.name}', line "
+                f"{tw.node.lineno}) AND by the main loop "
+                f"('{mw.fn.name}', line {mw.node.lineno}) with no common "
+                "lock — a write/write race.  Hold one shared lock around "
+                "both writes, or justify with '# lint: single-writer'",
+            )
+
+    @staticmethod
+    def _unlocked_pair(thread_ws, main_ws):
+        for tw in thread_ws:
+            for mw in main_ws:
+                if not (tw.locks & mw.locks):
+                    return tw, mw
+        return None

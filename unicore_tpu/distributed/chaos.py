@@ -70,6 +70,14 @@ Kinds (all persistent from STEP onward unless noted):
     30) from STEP onward, on EVERY rank (the outage is a property of the
     service, not a host).  Proves every KV wait is deadline-bounded
     through ``utils/retry.py`` — bounded blocking, never a hang.
+``collective-order-skew@STEP[@RANK]``
+    The targeted rank silently SKIPS its next host collective once the
+    step counter reaches STEP (consumed once) — manufactured divergent
+    control flow, exactly what the ``collective-divergence`` lint refuses
+    statically.  Without ``--sanitize-collectives`` the peers hang inside
+    the skipped collective until the watchdog; with it, the pre-
+    collective fingerprint exchange names the skewed rank within one
+    exchange and aborts BEFORE anyone enters the mismatched collective.
 ``request-flood[:QPS]@STEP``
     Serving plane only: from serve-batch STEP onward the CLI's synthetic
     traffic generator offers QPS (default 200) requests per second for a
@@ -130,6 +138,7 @@ KINDS = (
     "host-loss",
     "heartbeat-stall",
     "kv-outage",
+    "collective-order-skew",
     "request-flood",
     "slow-client",
     "corrupt-reload",
@@ -387,6 +396,29 @@ def maybe_perturb_geometry(step: int, samples: List):
         )
         break
     return out
+
+
+def take_collective_skip(name: str) -> bool:
+    """``collective-order-skew``: True exactly once, when the targeted
+    rank should silently skip this host collective — simulated divergent
+    control flow (one rank's code path 'never reaches' the collective its
+    peers are entering).  Consumed after one skip: one skew is enough to
+    prove the sanitizer names the rank; skipping every later collective
+    would just re-prove it while making the abort path untestable."""
+    if (
+        _plan is None
+        or _plan.kind != "collective-order-skew"
+        or _plan.consumed
+        or not _plan.active(_last_step)
+    ):
+        return False
+    _plan.consumed = True
+    logger.warning(
+        f"chaos: collective-order-skew — rank {_plan.rank} SKIPS host "
+        f"collective '{name}' at step {_last_step} (its peers will enter "
+        "it without this rank)"
+    )
+    return True
 
 
 def maybe_delay_collective(name: str) -> None:

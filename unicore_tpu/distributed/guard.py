@@ -463,7 +463,8 @@ def _ensure_worker():
     return _requests
 
 
-def run_collective(name: str, fn):
+def run_collective(name: str, fn, geometry: Optional[str] = None,
+                   local=None):
     """Run one host-side collective under the watchdog.
 
     With the watchdog disabled (``--collective-timeout 0``) this is a
@@ -474,23 +475,49 @@ def run_collective(name: str, fn):
     orphaned worker may complete the stalled collective later, and letting
     a new one proceed would pair mismatched payloads across hosts), and
     raises — a stalled collective becomes a diagnosed abort instead of an
-    infinite hang."""
-    global _worker, _poisoned
-    from unicore_tpu.distributed import chaos
+    infinite hang.
 
-    timeout = _collective_timeout
-    if timeout <= 0 and _collective_abort_check is None:
-        # no watchdog AND no elastic abort hook: nothing to poll for, so
-        # skip the worker-thread indirection entirely
-        chaos.maybe_delay_collective(name)
-        return fn()
+    ``geometry`` is an optional payload-shape description the wrappers
+    pass for geometry-rigid collectives; with ``--sanitize-collectives``
+    armed it rides the pre-collective fingerprint exchange
+    (:mod:`~unicore_tpu.distributed.sanitizer`), which aborts with a
+    named-rank :class:`CollectiveDivergenceError` BEFORE a divergent
+    collective is entered — instead of hanging to this watchdog.
+
+    ``local`` is the wrapper's single-process fallback (the same value
+    its ``process_count() == 1`` early path returns): a chaos
+    ``collective-order-skew`` skip returns it so the skewed rank keeps
+    EXECUTING — exactly like real divergent control flow, where the rank
+    that never reached the collective is off running something else, not
+    crashed on a None result."""
+    global _worker, _poisoned
+    from unicore_tpu.distributed import chaos, sanitizer
+
+    if chaos.take_collective_skip(name):
+        # divergent control flow, manufactured: this rank behaves as if
+        # its code path never reached the collective.  Its sanitizer
+        # sequence counter does NOT advance — the lag is exactly what the
+        # peers' next fingerprint exchange names.
+        return local() if local is not None else None
     if _poisoned is not None:
+        # refused BEFORE the sanitizer exchange: publishing a fingerprint
+        # and then not entering would tell the peers "I'm coming" and
+        # strand them inside the collective until the watchdog — staying
+        # silent gives them a named stranded-rank verdict within
+        # --sanitize-timeout instead
         raise CollectiveTimeoutError(
             f"collective '{name}' refused: the collective plane was "
             f"poisoned by an earlier watchdog timeout ({_poisoned}) and "
             "this process can no longer exchange data with its peers "
             "coherently; restart the process"
         )
+    sanitizer.check(name, geometry)
+    timeout = _collective_timeout
+    if timeout <= 0 and _collective_abort_check is None:
+        # no watchdog AND no elastic abort hook: nothing to poll for, so
+        # skip the worker-thread indirection entirely
+        chaos.maybe_delay_collective(name)
+        return fn()
 
     def work():
         chaos.maybe_delay_collective(name)  # delays count against the budget

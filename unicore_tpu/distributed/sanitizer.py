@@ -1,0 +1,366 @@
+"""Runtime collective sanitizer (``--sanitize-collectives``).
+
+The static half of this PR (the ``collective-divergence`` lint) refuses
+rank-conditional collective patterns it can SEE; this is the runtime half
+for the ones it can't — data-dependent divergence, a third-party plugin,
+a desynced step counter.  Today those all present the same way: every
+healthy rank blocks inside a host collective until the watchdog fires at
+``--collective-timeout`` (default 30 MINUTES) with "a peer has likely
+desynced" and no name.
+
+With the sanitizer armed, every rank publishes a cheap fingerprint —
+collective sequence number, call site, payload geometry — to the
+coordination-service KV store immediately before entering each host
+collective, and reads its peers' fingerprints for the same sequence
+number back (deadline-bounded through ``utils/retry.py``, so a dark KV
+service degrades to a diagnosed timeout, never a hang).  Divergence
+surfaces at the EXCHANGE, before anyone enters the mismatched collective:
+
+* a peer publishes a DIFFERENT call site for this sequence number → it
+  skipped or reordered a collective — majority vote names the divergent
+  rank(s) and both call sites;
+* a peer publishes a different payload geometry for a geometry-rigid
+  collective (all_reduce shape/dtype, all_reduce_dict key set) → named
+  rank + both geometries (the crossed-payload corruption case);
+* a peer publishes NOTHING within ``--sanitize-timeout`` → it never
+  reached host collective #seq — named as stranded.
+
+Every verdict raises :class:`CollectiveDivergenceError` (a
+``ConsistencyError``, so the CLI's exit-code taxonomy and the elastic
+supervisor's retry classification treat it like the guard's own
+diagnoses) and journals a ``collective-divergence`` event via PR 8's
+telemetry plane.  Off by default: the exchange costs one KV write + one
+KV read per peer per host collective — host collectives are control-plane
+(a handful per epoch), but the flag exists for debugging runs, chaos
+tests, and CI, not for shaving microseconds.
+
+Proven by chaos kind ``collective-order-skew@STEP[@RANK]`` — the targeted
+rank silently skips its next host collective, exactly the divergent
+control flow the static lint would have refused.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from unicore_tpu.distributed.guard import ConsistencyError
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_TIMEOUT_S = 30.0
+#: exchanges older than this many sequence numbers are garbage-collected
+#: from the KV store by rank 0 (any rank that far behind has long since
+#: drawn a stranded-rank verdict)
+_GC_LAG = 64
+
+_enabled = False
+_timeout_s = _DEFAULT_TIMEOUT_S
+_seq = 0
+_lock = threading.Lock()
+_prefix: Optional[str] = None
+
+
+class CollectiveDivergenceError(ConsistencyError):
+    """Ranks disagree about which host collective comes next (or one
+    never arrived).  A ``ConsistencyError``, so the CLI exit-code
+    taxonomy and the elastic supervisor's retry classification treat it
+    like the guard's own named-rank diagnoses."""
+
+
+def configure(args) -> None:
+    """Arm/disarm from parsed args (idempotent; beside guard/chaos
+    configure in the trainer)."""
+    global _enabled, _timeout_s, _prefix
+    _enabled = bool(getattr(args, "sanitize_collectives", False))
+    # explicit None check: --sanitize-timeout 0 means "fail fast", not
+    # "use the default" (the deadline_ms lesson from the serve transport)
+    raw_timeout = getattr(args, "sanitize_timeout", None)
+    _timeout_s = (
+        _DEFAULT_TIMEOUT_S if raw_timeout is None else float(raw_timeout)
+    )
+    run_id = os.environ.get("UNICORE_TPU_RUN_ID", "run")
+    epoch = os.environ.get("UNICORE_TPU_MEMBERSHIP_EPOCH", "0") or "0"
+    attempt = os.environ.get("UNICORE_TPU_ELASTIC_RESTARTS", "0") or "0"
+    # namespaced per run incarnation: an elastic restart replays sequence
+    # numbers from zero and must never read the dead incarnation's keys
+    _prefix = f"unicore/sanitize/{run_id}/{epoch}.{attempt}"
+    if _enabled:
+        logger.info(
+            f"collective sanitizer ARMED (timeout {_timeout_s:g}s, "
+            f"namespace {_prefix}): ranks exchange call-site fingerprints "
+            "before every host collective"
+        )
+
+
+def reset() -> None:
+    global _enabled, _timeout_s, _seq, _prefix
+    _enabled = False
+    _timeout_s = _DEFAULT_TIMEOUT_S
+    _seq = 0
+    _prefix = None
+
+
+def enabled() -> bool:
+    if not _enabled:
+        return False
+    import jax
+
+    return jax.process_count() > 1
+
+
+def _fingerprint(name: str, geometry: Optional[str]) -> Dict[str, Any]:
+    from unicore_tpu.distributed import guard
+
+    return {
+        "site": name,
+        "geom": geometry,
+        "step": guard.last_step(),
+    }
+
+
+def check(name: str, geometry: Optional[str] = None) -> None:
+    """Fingerprint exchange before one host collective.
+
+    Publishes ``(seq, call site, geometry)``, reads every peer's entry
+    for the same ``seq``, and raises :class:`CollectiveDivergenceError`
+    naming the divergent/stranded rank(s) on mismatch.  Geometry is
+    compared only when BOTH sides report one (wrappers pass it for
+    geometry-rigid collectives; broadcast/all_gather_list payloads may
+    legitimately differ per rank and pass None)."""
+    global _seq
+    if not enabled():
+        return
+    import jax
+
+    from unicore_tpu.utils import retry
+
+    client = retry.coordination_client()
+    if client is None:
+        return
+    me = jax.process_index()
+    world = jax.process_count()
+    with _lock:
+        seq = _seq
+        _seq += 1
+    mine = _fingerprint(name, geometry)
+    own_key = f"{_prefix}/{seq}/{me}"
+    try:
+        client.key_value_set(own_key, json.dumps(mine))
+    except Exception as err:
+        # the publish is the one raw client call here: a dark KV service
+        # at publish time takes the SAME degrade path as dark reads —
+        # never an opaque backend traceback, never a verdict blaming
+        # peers for a service outage
+        _proceed_unverified(seq, name, f"publish failed: {err}")
+        return
+
+    peers: Dict[int, Optional[Dict[str, Any]]] = {me: mine}
+    stranded = []
+    # ONE deadline across the whole exchange: the peers publish
+    # concurrently, so the detection bound is --sanitize-timeout total,
+    # not (stranded peers) x timeout serially.  Once it expires the
+    # remaining peers get one NON-blocking probe each (their keys may
+    # already be there) — a large stranded set can't re-serialize the
+    # exchange through per-peer minimum waits.
+    exchange_deadline = time.monotonic() + _timeout_s
+    for peer in range(world):
+        if peer == me:
+            continue
+        key = f"{_prefix}/{seq}/{peer}"
+        left = exchange_deadline - time.monotonic()
+        raw = None
+        if left <= 0:
+            probe = retry.kv_fetch(client, key, poll_ms=50)
+            raw = probe if isinstance(probe, str) else None
+        else:
+            try:
+                raw = retry.kv_wait(
+                    client,
+                    key,
+                    timeout=left,
+                    poll_s=0.2,
+                    describe=f"sanitizer fingerprint of rank {peer} for "
+                    f"host collective #{seq}",
+                )
+            except retry.KVTimeoutError:
+                raw = None
+        if raw is None:
+            peers[peer] = None
+            stranded.append(peer)
+        else:
+            peers[peer] = json.loads(raw)
+
+    if seq >= _GC_LAG and me == 0:
+        try:  # best-effort GC; absence of cleanup never fails a run
+            client.key_value_delete(f"{_prefix}/{seq - _GC_LAG}/")
+        except Exception:
+            pass
+
+    if stranded:
+        # silence from a PEER is evidence only while the KV SERVICE
+        # answers (the elastic heartbeat monitor's rule): read back our
+        # own just-written key — if even that is unreadable, the store
+        # is dark (real outage or the kv-outage chaos kind), and blaming
+        # every healthy peer for it would send the operator to the wrong
+        # machines.  Degrade to an UNVERIFIED collective instead: the
+        # watchdog still guards it.
+        probe = retry.kv_fetch(client, own_key)
+        if not isinstance(probe, str):
+            _proceed_unverified(seq, name, "kv-plane-unreachable")
+            return
+
+    verdict = _diagnose(name, seq, me, peers, stranded)
+    if verdict is None:
+        return
+    from unicore_tpu import telemetry
+
+    logger.error(f"COLLECTIVE-DIVERGENCE VERDICT: {verdict}")
+    telemetry.emit(
+        "collective-divergence",
+        seq=seq,
+        collective=name,
+        verdict=verdict,
+        stranded=stranded,
+        fingerprints={str(r): fp for r, fp in peers.items()},
+    )
+    raise CollectiveDivergenceError(verdict)
+
+
+def _proceed_unverified(seq: int, name: str, reason: str) -> None:
+    """The KV plane cannot serve this exchange (dark at publish or at
+    every read): warn + journal, and let the collective run UNVERIFIED —
+    the watchdog still guards it, and a transient outage must degrade,
+    never abort the run with a verdict blaming healthy peers."""
+    logger.warning(
+        f"collective sanitizer: could not verify host collective #{seq} "
+        f"('{name}') — {reason}; the coordination-service KV plane is "
+        "dark, not the peers; proceeding unverified under the collective "
+        "watchdog"
+    )
+    from unicore_tpu import telemetry
+
+    telemetry.emit(
+        "collective-sanitizer-unverified",
+        seq=seq,
+        collective=name,
+        reason=reason,
+    )
+
+
+def _diagnose(
+    name: str,
+    seq: int,
+    me: int,
+    peers: Dict[int, Optional[Dict[str, Any]]],
+    stranded,
+) -> Optional[str]:
+    """Majority-vote verdict text, or None when every rank agrees."""
+    if stranded:
+        ranks = ", ".join(str(r) for r in stranded)
+        return (
+            f"rank(s) {ranks} never reached host collective #{seq} "
+            f"('{name}' at step {peers[me]['step']}) within "
+            f"{_timeout_s:g}s: divergent control flow or a wedged host — "
+            "aborting BEFORE entering the collective instead of hanging "
+            "until the collective watchdog"
+        )
+    # the three comparisons share one split/vote/detail scaffolding and
+    # differ only in grouping and phrasing — checked causally upstream
+    # first: a different CALL SITE explains a step or geometry mismatch,
+    # never the other way around
+    site_split = _split(
+        {r: fp["site"] for r, fp in peers.items()}, lambda s: f"at '{s}'"
+    )
+    if site_split:
+        ranks, reference, who, detail, note = site_split
+        return (
+            f"host collective #{seq} DIVERGED: rank(s) {ranks} published "
+            f"a different call site than {who} '{reference}' ({detail}) "
+            "— a collective was skipped or reordered on the named "
+            "rank(s)" + note
+        )
+    # same call site: compare the TRAINING STEP each rank reached it at.
+    # Without this, a rank that skipped a periodic collective (same site,
+    # same geometry every log interval) would pass the exchange one step
+    # behind forever, silently crossing step-100 payloads with step-101's.
+    step_split = _split(
+        {r: str(fp.get("step")) for r, fp in peers.items()},
+        lambda s: f"at step {s}",
+    )
+    if step_split:
+        ranks, _, who, detail, note = step_split
+        return (
+            f"host collective #{seq} ('{name}') reached at DIFFERENT "
+            f"training steps: rank(s) {ranks} disagree with {who} "
+            f"({detail}) — a periodic collective was skipped on the "
+            "lagging side; entering it would cross payloads across steps"
+            + note
+        )
+    geom_split = _split(
+        {
+            r: fp["geom"]
+            for r, fp in peers.items()
+            if fp.get("geom") is not None
+        },
+        lambda g: f"with {g}",
+    )
+    if geom_split:
+        ranks, _, who, detail, note = geom_split
+        return (
+            f"host collective #{seq} ('{name}') carries MISMATCHED "
+            f"payload geometry: rank(s) {ranks} disagree with {who} "
+            f"({detail}) — entering it would silently cross payloads"
+            + note
+        )
+    return None
+
+
+def _split(values: Dict[int, str], describe):
+    """None when every rank agrees; else the verdict pieces for a split:
+    ``(divergent ranks, reference value, who, per-group detail,
+    ambiguity note)``."""
+    groups: Dict[str, list] = {}
+    for rank, value in values.items():
+        groups.setdefault(value, []).append(rank)
+    if len(groups) <= 1:
+        return None
+    divergent, reference, ambiguous = _vote(groups)
+    detail = "; ".join(
+        f"rank(s) {', '.join(map(str, sorted(rs)))} {describe(v)}"
+        for v, rs in sorted(groups.items())
+    )
+    who = "the reference group" if ambiguous else "the majority"
+    note = (
+        ".  NOTE: no strict majority exists, so the vote is ambiguous — "
+        "the named rank(s) fall outside the reference group, which may "
+        "itself be the divergent side"
+        if ambiguous
+        else ""
+    )
+    return ", ".join(map(str, divergent)), reference, who, detail, note
+
+
+def _vote(groups: Dict[str, list]):
+    """``(divergent ranks, reference value, ambiguous)`` by majority
+    vote.  With no single largest group (2 hosts, a 2-2 split) naming
+    one side as THE divergent rank would confidently send the operator
+    to the wrong machine — same convention as
+    guard.diagnose_fingerprints: the suspects are the ranks outside the
+    reference group and the verdict says the vote is ambiguous.  The
+    reference among TIED largest groups prefers the one holding rank 0
+    (the 2-host convention) but never an outvoted rank-0 singleton: in
+    an {A: [0], B: [1, 2], C: [3, 4]} split rank 0 is a suspect, not
+    the anchor."""
+    best = max(len(rs) for rs in groups.values())
+    top = sorted(v for v, rs in groups.items() if len(rs) == best)
+    ambiguous = len(top) > 1
+    reference = next(
+        (v for v in top if 0 in groups[v]), top[0]
+    )
+    divergent = sorted(
+        r for v, rs in groups.items() if v != reference for r in rs
+    )
+    return divergent, reference, ambiguous

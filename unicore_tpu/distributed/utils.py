@@ -26,12 +26,17 @@ logger = logging.getLogger(__name__)
 _initialized = False
 
 
-def _timed(name, fn):
+def _timed(name, fn, geometry=None, local=None):
     """Run one host collective under the watchdog (guard.run_collective):
     with ``--collective-timeout`` set, a stalled peer turns into a
     diagnosed abort (thread stacks + last fingerprint) instead of an
-    infinite hang."""
-    return guard.run_collective(name, fn)
+    infinite hang.  ``geometry`` (payload shape/dtype for geometry-rigid
+    collectives) rides the ``--sanitize-collectives`` fingerprint
+    exchange so crossed payloads are named BEFORE the collective runs;
+    ``local`` is this wrapper's single-process value, returned when a
+    chaos ``collective-order-skew`` skip makes this rank behave as if it
+    never reached the collective."""
+    return guard.run_collective(name, fn, geometry=geometry, local=local)
 
 
 def infer_init_method(args):
@@ -196,7 +201,13 @@ def all_reduce(tensor, op="sum"):
     """Host-level all-reduce of a small array across processes."""
     if jax.process_count() == 1:
         return tensor
-    return _timed("all_reduce", lambda: _all_reduce_impl(tensor, op))
+    arr = np.asarray(tensor)
+    return _timed(
+        "all_reduce",
+        lambda: _all_reduce_impl(arr, op),
+        geometry=f"shape={tuple(arr.shape)} dtype={arr.dtype} op={op}",
+        local=lambda: arr,
+    )
 
 
 def _all_reduce_impl(tensor, op):
@@ -233,7 +244,9 @@ def all_gather_list(data, group=None, max_size=None):
     if jax.process_count() == 1:
         return [data]
     return _timed(
-        "all_gather_list", lambda: _all_gather_list_impl(data, max_size)
+        "all_gather_list",
+        lambda: _all_gather_list_impl(data, max_size),
+        local=lambda: [data],
     )
 
 
@@ -297,7 +310,14 @@ def all_reduce_dict(data: Dict[str, Any], device=None, group=None) -> Dict[str, 
         return dict(data)
     keys = sorted(data.keys())
     vec = np.asarray([float(data[k]) for k in keys], dtype=np.float64)
-    out = _timed("all_reduce_dict", lambda: _all_reduce_impl(vec, "sum"))
+    out = _timed(
+        "all_reduce_dict",
+        lambda: _all_reduce_impl(vec, "sum"),
+        # the key SET is the geometry: a host carrying a different metric
+        # set would silently mis-pair every scalar after the mismatch
+        geometry=f"keys={','.join(keys)}",
+        local=lambda: vec,
+    )
     return {k: out[i] for i, k in enumerate(keys)}
 
 
@@ -342,6 +362,10 @@ def all_to_all(tensor, group=None):
     gathered = _timed(
         "all_to_all",
         lambda: multihost_utils.process_allgather(_as_bytes(arr)),
+        geometry=f"shape={tuple(arr.shape)} dtype={arr.dtype}",
+        # the skip fallback must still satisfy the (n, bytes) contract
+        # the slicing below consumes — n copies of the local payload
+        local=lambda: np.stack([_as_bytes(arr)] * n),
     )  # (n, bytes)
     return np.concatenate(
         [
@@ -363,6 +387,7 @@ def broadcast_tensors(tensors, src_rank=0, group=None, dist_device=None):
     return _timed(
         "broadcast_tensors",
         lambda: _broadcast_tensors_impl(tensors, src_rank),
+        local=lambda: tensors,
     )
 
 
@@ -404,7 +429,9 @@ def broadcast_object(obj, src_rank=0, group=None):
     if jax.process_count() == 1:
         return obj
     return _timed(
-        "broadcast_object", lambda: _broadcast_object_impl(obj, src_rank)
+        "broadcast_object",
+        lambda: _broadcast_object_impl(obj, src_rank),
+        local=lambda: obj,
     )
 
 
@@ -454,5 +481,7 @@ def barrier(tag: str = "barrier") -> None:
     from jax.experimental import multihost_utils
 
     _timed(
-        f"barrier:{tag}", lambda: multihost_utils.sync_global_devices(tag)
+        f"barrier:{tag}",
+        lambda: multihost_utils.sync_global_devices(tag),
+        local=lambda: None,
     )

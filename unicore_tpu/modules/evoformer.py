@@ -329,12 +329,14 @@ def _sharded_flash(plan, seq_dim, q, k, v, bias, kvm, H, head_dim):
         )
         return o.reshape(*lead_loc, H, lq, head_dim)
 
-    fn = jax.shard_map(
+    from unicore_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=tuple(specs),
         out_specs=P(*q_spec),
-        # pallas_call out_shapes carry no varying-across-mesh annotation
+        # pallas_call out_shapes carry no replication/vma annotation
         # (same caveat as ring_self_attention); equivalence tests cover it
         check_vma=False,  # lint: jax-version-pinned
     )

@@ -192,10 +192,10 @@ def add_serving_args(parser):
                        help="accepted for script compatibility with the "
                             "training CLI; serving is deterministic (eval-"
                             "mode forwards, constant warm-up dummies) and "
-                            "consumes no rng")  # lint: compat-flag
+                            "consumes no rng")
     group.add_argument("--no-progress-bar", action="store_true",
                        help="accepted for script compatibility with the "
-                            "training CLI")  # lint: compat-flag
+                            "training CLI")
     return group
 
 
@@ -547,6 +547,24 @@ def add_distributed_training_args(parser, default_world_size=None):
                             "collective stalled longer than this dumps all "
                             "thread stacks + the last fingerprint and raises "
                             "instead of hanging forever (0 disables)")
+    group.add_argument("--sanitize-collectives", action="store_true",
+                       help="exchange a cheap fingerprint (sequence number, "
+                            "call site, payload geometry) through the "
+                            "coordination-service KV store before EVERY "
+                            "host collective: ranks that skipped/reordered "
+                            "a collective or carry mismatched payload "
+                            "geometry are named in a "
+                            "CollectiveDivergenceError BEFORE anyone enters "
+                            "the collective, instead of hanging to "
+                            "--collective-timeout (distributed/sanitizer.py;"
+                            " off by default — one KV write + one read per "
+                            "peer per host collective)")
+    group.add_argument("--sanitize-timeout", type=float, default=30.0,
+                       metavar="SECS",
+                       help="how long the sanitizer waits for each peer's "
+                            "fingerprint before naming it stranded (the "
+                            "bound on divergence detection; keep well under "
+                            "--collective-timeout)")
     group.add_argument("--fault-inject", type=str, default=None,
                        metavar="KIND[:PARAM]@STEP[@RANK]",
                        help="chaos harness (distributed/chaos.py): inject "
@@ -561,7 +579,11 @@ def add_distributed_training_args(parser, default_world_size=None):
                             "heartbeat-stall[:SECS] (alive but silent), and "
                             "kv-outage[:SECS] (coordination service dark, "
                             "every rank) prove the elastic control plane "
-                            "detects, bounds, and restarts")
+                            "detects, bounds, and restarts; "
+                            "collective-order-skew (the targeted rank "
+                            "silently skips one host collective) proves "
+                            "--sanitize-collectives names the skewed rank "
+                            "before the collective hangs")
     # elastic run control plane (distributed/elastic.py,
     # docs/robustness.md "Elastic runs")
     group.add_argument("--elastic", action="store_true",

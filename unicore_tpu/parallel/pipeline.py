@@ -124,10 +124,15 @@ def gpipe(
             lambda a: jnp.zeros_like(a), mb0
         )
         outs0 = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), mbs)
-        if manual_axes is not None:
-            # vma checking is on (partial-manual mode): the scan carries
-            # BECOME pipe-varying after one tick (r is pipe-varying), so
-            # the initial values must be cast to match the carry type
+        from unicore_tpu.parallel.compat import HAS_VMA_SHARD_MAP
+
+        if manual_axes is not None and HAS_VMA_SHARD_MAP:
+            # partial-manual under the vma-typed generation (the ONLY one
+            # that can run it — same probe as the dispatch in compat.py):
+            # the scan carries BECOME pipe-varying after one tick (r is
+            # pipe-varying), so the initial values must be cast to match
+            # the carry type.  The experimental API has no varying-type
+            # system and partial-manual is refused there outright.
             mark = lambda a: jax.lax.pcast(a, (pipe_axis,), to="varying")
             zeros_mb = jax.tree_util.tree_map(mark, zeros_mb)
             outs0 = jax.tree_util.tree_map(mark, outs0)
@@ -194,24 +199,22 @@ def gpipe(
         in_specs.append(P())
         operands.append(rng)
 
-    fn = jax.shard_map(
+    from unicore_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=jax.tree_util.tree_map(lambda _: mb_spec, microbatches),
-        # full-manual runs name every mesh axis EXPLICITLY rather than
-        # leaning on empty-set-means-all semantics (which newer jax
-        # versions read as "manual over nothing")
-        axis_names=(
-            frozenset(mesh.shape)
-            if manual_axes is None
-            else frozenset(manual_axes)
-        ),
-        # partial-manual (manual_axes set) REQUIRES vma checking: the
-        # eager path's unmatch step otherwise builds an all-axes spec that
-        # mentions the auto axes and is rejected.  Full-manual keeps
-        # vma checking off (the stage body may contain pallas_call, whose
-        # out_shapes carry no varying-across-mesh annotation).
+        # partial-manual needs the vma-typed generation (compat.py is the
+        # one dispatch point; seq_pipeline_plan keys on the SAME probe,
+        # and a direct caller on older jax gets a named refusal, never
+        # the XLA partitioner crash).  Partial-manual REQUIRES vma
+        # checking — the eager path's unmatch step otherwise builds an
+        # all-axes spec that mentions the auto axes and is rejected;
+        # full-manual keeps it off (the stage body may contain
+        # pallas_call, whose out_shapes carry no vma annotation).
+        manual_axes=manual_axes,
         check_vma=manual_axes is not None,
     )
     return fn(*operands)

@@ -54,10 +54,18 @@ def pallas_ring_supported(Lc, head_dim, dtype):
     )
 
 
-def _chunk_seed(seed, my_idx, src, n):
+def _chunk_seed(seed, my_idx, src, n, dropout_rate):
     """Dropout stream id for the (query-chunk my_idx, key-chunk src) pair —
     a function of GLOBAL chunk identities, so the backward ring regenerates
-    the identical in-kernel masks regardless of visit order."""
+    the identical in-kernel masks regardless of visit order.
+
+    Without dropout the kernels never read the seed, so a constant is
+    passed instead: the axis_index-derived value would otherwise ride the
+    scalar-prefetch operand into XLA's SPMD partitioner, which rejects the
+    resulting PartitionId instruction ("meaning is ambiguous") when the
+    seed is the only axis_index consumer (the bias-free jit path)."""
+    if dropout_rate <= 0.0:
+        return jnp.zeros((1,), jnp.int32)
     return jnp.reshape(
         seed * jnp.int32(7919)
         + my_idx.astype(jnp.int32) * jnp.int32(n)
@@ -94,7 +102,7 @@ def _ring_flash_fwd_impl(axis_name, sm_scale, dropout_rate, q, k, v, kv_mask,
         mask3 = mask_blk.astype(jnp.int32)[:, None, :]
         o_t, lse_t = fa._fwd(
             q, k_blk, v_blk, bias4, mask3,
-            _chunk_seed(seed, my, src, n),
+            _chunk_seed(seed, my, src, n, dropout_rate),
             sm_scale, dropout_rate, 256, 512,
         )
         # logsumexp combine of per-chunk results: exp(lse_t - m) * o_t is
@@ -167,7 +175,7 @@ def _ring_flash_bwd(axis_name, sm_scale, dropout_rate, res, do):
         # is exact — no cross-chunk correction needed
         dq_c, dk_c, dv_c, db_c = fa._bwd(
             q, k_blk, v_blk, bias4, mask3,
-            _chunk_seed(seed, my, src, n),
+            _chunk_seed(seed, my, src, n, dropout_rate),
             sm_scale, dropout_rate, 256, 512, out, lse, do,
         )
         dq = dq + dq_c.astype(jnp.float32)
@@ -406,13 +414,16 @@ def ring_self_attention(
             extra_rng_axes=(batch_axis,) if batch_axis else (),
         )
 
-    fn = jax.shard_map(
+    from unicore_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=out_spec,
-        # pallas_call out_shapes carry no varying-across-mesh annotation;
-        # replication correctness is covered by the equivalence tests
+        # pallas_call out_shapes carry no replication/vma annotation, so
+        # checking is off on either API generation; replication
+        # correctness is covered by the equivalence tests
         check_vma=False,  # lint: jax-version-pinned
     )
     return fn(*operands)

@@ -180,12 +180,14 @@ def ulysses_self_attention(
         )
         operands.append(bias)
 
-    fn = jax.shard_map(
+    from unicore_tpu.parallel.compat import shard_map
+
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=qkv_spec,
-        # pallas_call out_shapes carry no varying-across-mesh annotation
+        # pallas_call out_shapes carry no replication/vma annotation
         # (same caveat as ring_self_attention); equivalence tests cover it
         check_vma=False,  # lint: jax-version-pinned
     )

@@ -149,15 +149,24 @@ class ServeEngine:
     def ready(self) -> bool:
         return self._ready
 
-    def set_ready(self, ready: bool, phase: Optional[str] = None) -> None:
-        # draining/stopped are terminal: a hot reload that raced SIGTERM
-        # must not flip readiness back on and have a load balancer route
-        # traffic at a server that sheds everything
-        if self._phase in (PHASE_DRAINING, PHASE_STOPPED):
-            return
-        self._ready = bool(ready)
-        if phase is not None:
-            self._phase = phase
+    def set_ready(self, ready: bool, phase: Optional[str] = None) -> bool:
+        """Readiness/phase transition; False when refused because the
+        engine is already terminal.
+
+        Draining/stopped are terminal: a hot reload (or a warm-up tail)
+        that raced SIGTERM must not flip readiness back on and have a
+        load balancer route traffic at a server that sheds everything.
+        The lock pairs the terminal-phase check with the write — without
+        it a reload thread's set_ready(True) can interleave with the
+        loop thread's death transition and resurrect readiness on a dead
+        engine."""
+        with self._lock:
+            if self._phase in (PHASE_DRAINING, PHASE_STOPPED):
+                return False
+            self._ready = bool(ready)
+            if phase is not None:
+                self._phase = phase
+            return True
 
     # -- warm-up ---------------------------------------------------------
 
@@ -166,8 +175,11 @@ class ServeEngine:
         program before the first real request; flips readiness true.
         Returns the number of programs compiled — the acceptance bound is
         ``<= len(bucket_edges)``."""
-        self._phase = PHASE_WARMING
-        self._ready = False
+        if not self.set_ready(False, PHASE_WARMING):
+            # already terminal (a SIGTERM beat the warm-up): compiling a
+            # program per bucket on an engine that will never serve only
+            # stalls the drain past its deadline
+            return 0
         t0 = time.monotonic()
         for edge in self.bucket_edges:
             dummy = np.full(
@@ -182,7 +194,8 @@ class ServeEngine:
             _block_on(self.infer_fn(self.variables, dummy))
             self.queue.note_batch_service(time.monotonic() - tb0)
         if self._cache_size_probe is not None:
-            self._warm_programs = self._cache_size_probe()
+            with self._lock:
+                self._warm_programs = self._cache_size_probe()
         programs = max(self._warm_programs, 0) or len(self.bucket_edges)
         logger.info(
             f"serve warm-up complete: {programs} program(s) for "
@@ -190,19 +203,27 @@ class ServeEngine:
             f"{list(self.bucket_edges)} x batch {self.batch_size} in "
             f"{time.monotonic() - t0:.1f}s; readiness -> true"
         )
-        self._phase = PHASE_SERVING
-        self._ready = True
-        self.queue.set_accepting(True)
+        # routed through set_ready so a stop() that raced the compile
+        # loop keeps the engine terminal (readiness and admission must
+        # never resurrect after a terminal transition)
+        if self.set_ready(True, PHASE_SERVING):
+            self.queue.set_accepting(True)
         return programs
 
     def _watch_recompiles(self) -> None:
         if self._cache_size_probe is None or self._warm_programs <= 0:
             return
         n = self._cache_size_probe()
-        if n > self._warm_programs:
-            grew = n - self._warm_programs
-            self._warm_programs = n
-            self.recompiles_after_warmup += grew
+        # the whole read-compare-update transition holds the lock (a
+        # guarded store alone couldn't stop two writers double-counting);
+        # the log line stays outside it
+        grew = 0
+        with self._lock:
+            if n > self._warm_programs:
+                grew = n - self._warm_programs
+                self._warm_programs = n
+                self.recompiles_after_warmup += grew
+        if grew:
             logger.warning(
                 f"recompile after warmup: {grew} new serve program(s) "
                 f"compiled at batch {self._batch_seq} ({n} total).  A "
@@ -285,8 +306,9 @@ class ServeEngine:
         except Exception as err:
             logger.exception("serve engine loop died")
             self.fatal_error = err
-            self._ready = False
-            self._phase = PHASE_STOPPED
+            with self._lock:
+                self._ready = False
+                self._phase = PHASE_STOPPED
             raise
 
     def healthy(self) -> bool:
@@ -418,8 +440,9 @@ class ServeEngine:
 
     def stop(self) -> None:
         self._stop.set()
-        self._phase = PHASE_STOPPED
-        self._ready = False
+        with self._lock:
+            self._phase = PHASE_STOPPED
+            self._ready = False
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=5.0)
 

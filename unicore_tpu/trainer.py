@@ -170,8 +170,10 @@ class Trainer(object):
         guard.configure(args)
         chaos.configure(args)
         from unicore_tpu.checkpoint import durable as ckpt_durable
+        from unicore_tpu.distributed import sanitizer
 
         ckpt_durable.configure(args)
+        sanitizer.configure(args)
         self.guard = guard.ConsistencyGuard(args)
         # training-health sentinel (unicore_tpu/health/): loss-spike /
         # grad-explosion / scale-collapse detection with in-memory rewind;

@@ -36,6 +36,16 @@ def get_lint_parser() -> argparse.ArgumentParser:
         help="print the registered rules and exit",
     )
     parser.add_argument(
+        "--format",
+        choices=("text", "sarif"),
+        default="text",
+        help=(
+            "output format: 'text' (path:line:col, the default) or "
+            "'sarif' (SARIF 2.1.0 JSON on stdout, for GitHub code-"
+            "scanning annotations); exit codes are identical either way"
+        ),
+    )
+    parser.add_argument(
         "--user-dir",
         default=None,
         help=(
@@ -72,8 +82,15 @@ def cli_main(argv: Optional[List[str]] = None) -> int:
     except FileNotFoundError as e:
         print(f"unicore-tpu-lint: {e}", file=sys.stderr)
         return 2
-    for v in violations:
-        print(v.format())
+    if args.format == "sarif":
+        import json
+
+        from unicore_tpu.analysis.sarif import to_sarif
+
+        print(json.dumps(to_sarif(violations, rules), indent=2))
+    else:
+        for v in violations:
+            print(v.format())
     if violations:
         print(
             f"unicore-tpu-lint: {len(violations)} violation(s) in "
