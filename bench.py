@@ -17,6 +17,14 @@ seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
                             offered load just under the shedding point —
                             req/s + p50/p90/p99 latency rows
                             (BENCH_SERVE_SECONDS, BENCH_SERVE_BUCKETS)
+    BENCH_CONFIG=kernels    device-side fused-kernel shootout: one row per
+                            op pair — softmax_dropout jnp-vs-Pallas,
+                            layernorm jnp-vs-Pallas, Adam tree_map-vs-fused
+                            multi-tensor — fwd+bwd (update for Adam) wall
+                            time per call.  On a non-TPU backend the Pallas
+                            kernels run in interpret mode and rows carry
+                            "pallas_interpret": true (a correctness/
+                            liveness proof, never a perf claim)
     BENCH_CONFIG=all        run every config; one JSON line each, failures
                             in one config don't lose the others' results
 
@@ -605,6 +613,174 @@ def run_serve_bench():
 
 
 # ---------------------------------------------------------------------------
+# fused-kernel shootout (BENCH_CONFIG=kernels)
+# ---------------------------------------------------------------------------
+
+def _time_fn(fn, *args, warmup=2, iters=None):
+    """Median wall ms per call of a jitted fn (completion via jax.block_until_ready)."""
+    import jax
+
+    if iters is None:
+        iters = int(os.environ.get("BENCH_KERNEL_ITERS", "5"))
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append((time.perf_counter() - t0) * 1000)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def _kernel_row(metric, jnp_ms, fused_ms, extra=None):
+    import jax
+
+    row = {
+        "metric": metric,
+        "value": round(fused_ms, 3),
+        "unit": "ms/call",
+        "vs_baseline": None,
+        "jnp_ms": round(jnp_ms, 3),
+        "fused_ms": round(fused_ms, 3),
+        "speedup": round(jnp_ms / fused_ms, 3) if fused_ms > 0 else None,
+    }
+    if extra:
+        row.update(extra)
+    try:
+        row["device_kind"] = jax.devices()[0].device_kind
+    except Exception as e:
+        sys.stderr.write(f"bench: device kind lookup failed: {e!r}\n")
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        row["cpu_fallback"] = True
+    _append_partial(row)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def run_kernel_bench():
+    """jnp-vs-fused rows for the device-side kernel suite (ROADMAP item 2):
+    each row times BOTH implementations of one op under jit — the win is a
+    measured number, not an assertion.  Pallas rows on a non-TPU backend
+    run in interpret mode (labeled; interpret wall time is a correctness
+    harness, not kernel speed — only real-TPU rows are perf claims)."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == "tpu"
+    from unicore_tpu.ops import _pallas
+
+    _pallas.set_interpret(not on_tpu)
+    interp = {"pallas_interpret": True} if not on_tpu else None
+
+    # CPU-sized defaults keep interpret-mode wall time sane; a real TPU
+    # run scales up via the env knobs
+    small = not on_tpu
+    rows = int(os.environ.get("BENCH_KERNEL_ROWS", "64" if small else "2048"))
+    seq = int(os.environ.get("BENCH_KERNEL_SEQ", "256" if small else "1024"))
+    dim = int(os.environ.get("BENCH_KERNEL_DIM", "256" if small else "1024"))
+
+    results = []
+    rng = np.random.RandomState(0)
+    key = None
+
+    # -- softmax_dropout: fwd+bwd at training dropout -------------------
+    sd = importlib.import_module("unicore_tpu.ops.softmax_dropout")
+    x = jnp.asarray(rng.randn(rows, seq).astype(np.float32)).reshape(
+        rows // 8, 8, seq
+    )
+    bias = jnp.asarray(rng.randn(1, 8, seq).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    def sd_loss(impl, x_, b_):
+        out = impl(x_, 0.1, is_training=True, bias=b_, dropout_rng=key)
+        return jnp.sum(out * out)
+
+    jnp_fn = jax.jit(jax.grad(lambda x_: sd_loss(
+        sd.softmax_dropout_reference, x_, bias)))
+    sd.set_softmax_dropout_mode("on")
+    try:
+        fused_fn = jax.jit(jax.grad(lambda x_: sd_loss(
+            sd.softmax_dropout, x_, bias)))
+        results.append(_kernel_row(
+            f"kernels_softmax_dropout_r{rows}_L{seq}_fwdbwd",
+            _time_fn(jnp_fn, x), _time_fn(fused_fn, x), interp,
+        ))
+    finally:
+        sd.set_softmax_dropout_mode(None)
+
+    # -- layer norm: fwd+bwd --------------------------------------------
+    from unicore_tpu.ops.fused_norm import fused_layer_norm
+
+    xn = jnp.asarray(rng.randn(rows * 8, dim).astype(np.float32))
+    w = jnp.ones((dim,), jnp.float32)
+    b = jnp.zeros((dim,), jnp.float32)
+
+    def ln_jnp(x_, w_, b_):
+        xf = x_.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        return ((xf - mean) * jax.lax.rsqrt(var + 1e-5) * w_ + b_).astype(x_.dtype)
+
+    jnp_ln = jax.jit(jax.grad(lambda x_: jnp.sum(ln_jnp(x_, w, b) ** 2)))
+    pal_ln = jax.jit(jax.grad(
+        lambda x_: jnp.sum(fused_layer_norm(x_, w, b) ** 2)))
+    results.append(_kernel_row(
+        f"kernels_layernorm_n{rows * 8}_d{dim}_fwdbwd",
+        _time_fn(jnp_ln, xn), _time_fn(pal_ln, xn), interp,
+    ))
+
+    # -- Adam: tree_map vs fused multi-tensor (runs NATIVELY everywhere —
+    # the fused path is flat-buffer XLA, not a Pallas kernel) -----------
+    from argparse import Namespace as _NS
+
+    from unicore_tpu.optim import OPTIMIZER_REGISTRY
+
+    n_leaves = int(os.environ.get("BENCH_KERNEL_LEAVES", "48"))
+    params = {
+        f"layer{i}": {
+            "kernel": jnp.asarray(rng.randn(dim, dim).astype(np.float32)),
+            "bias": jnp.asarray(rng.randn(dim).astype(np.float32)),
+        }
+        for i in range(n_leaves // 2)
+    }
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)), params
+    )
+
+    def adam_args(fused):
+        return _NS(
+            optimizer="adam", lr=[1e-3], adam_betas="(0.9, 0.999)",
+            adam_eps=1e-8, weight_decay=0.01, bf16_sr=False,
+            no_weight_decay_names="", fused_adam=fused,
+        )
+
+    def make_step(fused):
+        opt = OPTIMIZER_REGISTRY["adam"](adam_args(fused))
+        state = opt.init_state(params)
+
+        @jax.jit
+        def step(g, s, p):
+            # the clip rides the fused path too (trainer wiring)
+            g, _ = opt.clip_grad_norm(g, 1.0)
+            return opt.update(g, s, p, 1e-3)
+
+        return step, state
+
+    tree_step, tree_state = make_step(False)
+    fused_step, fused_state = make_step(True)
+    results.append(_kernel_row(
+        f"kernels_adam_clip_update_{n_leaves}leaves_d{dim}",
+        _time_fn(tree_step, grads, tree_state, params),
+        _time_fn(fused_step, grads, fused_state, params),
+    ))
+    return {"metric": "kernels_suite", "rows": len(results),
+            "vs_baseline": None}
+
+
+# ---------------------------------------------------------------------------
 # end-to-end input-pipeline mode (BENCH_PIPELINE=1, bert config)
 # ---------------------------------------------------------------------------
 
@@ -740,15 +916,18 @@ def main():
         return
     config = os.environ.get("BENCH_CONFIG", "bert")
     configs = (
-        ["bert", "unimol", "evoformer", "moe", "serve"] if config == "all"
-        else [config]
+        ["bert", "unimol", "evoformer", "moe", "serve", "kernels"]
+        if config == "all" else [config]
     )
     ok = False
     for c in configs:
         try:
-            runner = run_serve_bench if c == "serve" else (
-                lambda c=c: run_config(c)
-            )
+            if c == "serve":
+                runner = run_serve_bench
+            elif c == "kernels":
+                runner = run_kernel_bench
+            else:
+                runner = lambda c=c: run_config(c)
             print(json.dumps(runner()), flush=True)
             ok = True
         except Exception as e:  # partial results: one config's failure

@@ -46,6 +46,8 @@ _FAST_FILES = {
     "test_nan_detector.py",
     "test_softmax_dropout.py",
     "test_fused_norm.py",
+    "test_multi_tensor.py",
+    "test_fusion_audit.py",
     "test_serve.py",
     "test_telemetry.py",
 }

@@ -97,3 +97,102 @@ def test_module_use_pallas_matches_xla(module_cls):
     g2 = jax.grad(lambda pp: jnp.sum(m_x.apply(pp, x) ** 2))(p)
     for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
         assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# --fused-norm flag wiring (modules/layer_norm.py): one documented flag
+# drives the kernel selection, each module instance journals its path once
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def norm_flag():
+    import unicore_tpu.modules.layer_norm as ln_mod
+
+    prev_journal = set(ln_mod._journaled)
+    try:
+        yield ln_mod
+    finally:
+        ln_mod.configure_fused_norm(None)
+        ln_mod._journaled.clear()
+        ln_mod._journaled.update(prev_journal)
+
+
+def test_fused_norm_flag_selects_path(norm_flag, monkeypatch):
+    ln_mod = norm_flag
+    monkeypatch.delenv("UNICORE_TPU_PALLAS_NORM", raising=False)
+    calls = []
+    monkeypatch.setattr(
+        ln_mod, "_journal_choice",
+        lambda kind, dim, pallas, source: calls.append(
+            (kind, dim, pallas, source)
+        ),
+    )
+    ln_mod.configure_fused_norm("auto")
+    assert ln_mod._use_pallas(None, "LayerNorm", 64) is False
+    ln_mod.configure_fused_norm("on")
+    assert ln_mod._use_pallas(None, "LayerNorm", 64) is True
+    ln_mod.configure_fused_norm("off")
+    assert ln_mod._use_pallas(None, "LayerNorm", 64) is False
+    # explicit module attribute beats the flag; env beats both
+    assert ln_mod._use_pallas(True, "LayerNorm", 64) is True
+    monkeypatch.setenv("UNICORE_TPU_PALLAS_NORM", "0")
+    assert ln_mod._use_pallas(True, "LayerNorm", 64) is False
+    assert [c[3] for c in calls] == [
+        "flag:auto", "flag:on", "flag:off", "module", "env"
+    ]
+    with pytest.raises(ValueError):
+        ln_mod.configure_fused_norm("sometimes")
+
+
+def test_fused_norm_flag_end_to_end(norm_flag, monkeypatch):
+    """'on' routes the real module through the Pallas kernel and matches
+    the jnp path numerically."""
+    from unicore_tpu.modules import LayerNorm
+
+    ln_mod = norm_flag
+    monkeypatch.delenv("UNICORE_TPU_PALLAS_NORM", raising=False)
+    D = 128
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, D))
+    m = LayerNorm(D)
+    p = m.init(jax.random.PRNGKey(1), x)
+    ln_mod.configure_fused_norm("off")
+    ref = m.apply(p, x)
+    ln_mod.configure_fused_norm("on")
+    out = m.apply(p, x)
+    assert float(jnp.abs(out - ref).max()) < 1e-5
+
+
+def test_fused_norm_choice_journals_once(norm_flag, monkeypatch, tmp_path):
+    """One telemetry event per (kind, dim, path), not one per trace."""
+    import json
+    from argparse import Namespace
+
+    from unicore_tpu import telemetry
+
+    ln_mod = norm_flag
+    monkeypatch.delenv("UNICORE_TPU_PALLAS_NORM", raising=False)
+    telemetry.reset()
+    telemetry.configure(
+        Namespace(save_dir=None, telemetry_dir=str(tmp_path),
+                  telemetry_sample_interval=0, profile_steps=None),
+        rank=0, role="trainer",
+    )
+    try:
+        ln_mod._journaled.clear()
+        ln_mod.configure_fused_norm("auto")
+        for _ in range(3):
+            ln_mod._use_pallas(None, "LayerNorm", 77)
+        ln_mod._use_pallas(None, "RMSNorm", 77)
+        events = [
+            json.loads(ln)
+            for ln in open(telemetry.journal_path(), encoding="utf-8")
+            if ln.strip()
+        ]
+        norm_events = [e for e in events if e.get("kind") == "fused-norm-path"]
+        assert len(norm_events) == 2
+        assert {e["module"] for e in norm_events} == {"LayerNorm", "RMSNorm"}
+        assert all(e["path"] == "jnp" for e in norm_events)
+        assert all(e["source"] == "flag:auto" for e in norm_events)
+    finally:
+        telemetry.reset()

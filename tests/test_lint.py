@@ -433,6 +433,98 @@ def test_prng_negative_split_between_draws(tmp_path):
     assert vs == []
 
 
+def test_prng_pallas_invariant_seed_flagged(tmp_path):
+    """In-kernel seeding (the PR-9 ring-kernel bug class): a prng_seed
+    whose seed reaches only constants / *_ref operands is loop-invariant
+    across grid steps — every block draws the same bits."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(seed_ref, x_ref, o_ref):
+            i = pl.program_id(0)
+            pltpu.prng_seed(seed_ref[0])
+            bits = pltpu.prng_random_bits(x_ref.shape)
+            o_ref[...] = pltpu.bitcast(bits, jnp.uint32)
+
+        def kernel_const(x_ref, o_ref):
+            pltpu.prng_seed(42)
+            o_ref[...] = pltpu.prng_random_bits(x_ref.shape)
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert rule_names(vs) == ["prng-key-reuse", "prng-key-reuse"]
+    assert all("loop-invariant" in v.message for v in vs)
+
+
+def test_prng_pallas_mixed_seed_negative(tmp_path):
+    """Seeds mixed with program ids (directly or via a derived local, the
+    flash-attention idiom) vary per block — not flagged; a single-block
+    grid justifies the invariant seed with the escape."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kernel(seed_ref, x_ref, o_ref):
+            pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+            o_ref[...] = pltpu.prng_random_bits(x_ref.shape)
+
+        def kernel_mixed(seed_ref, b, h, o_ref):
+            mix = seed_ref[0]
+            for coord in (b, h):
+                mix = mix * jnp.int32(1000003) + coord
+            pltpu.prng_seed(mix)
+            o_ref[...] = pltpu.prng_random_bits(o_ref.shape)
+
+        def single_block(seed_ref, o_ref):
+            # lint: single-block-grid
+            pltpu.prng_seed(seed_ref[0])
+            o_ref[...] = pltpu.prng_random_bits(o_ref.shape)
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert vs == []
+
+
+def test_prng_pallas_seed_reuse_across_calls(tmp_path):
+    """One seed feeding two pallas_calls in one function = two kernels on
+    one stream; the deliberate fwd/bwd mask-recompute escape clears it,
+    and a non-seed first operand shared by two calls is not confused for
+    one."""
+    vs = run_lint(
+        tmp_path,
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def fwd_bwd(kernel, x, seed):
+            a = pl.pallas_call(kernel, grid=(4,))(seed, x)
+            b = pl.pallas_call(kernel, grid=(4,))(seed, x)
+            return a + b
+
+        def recompute(kernel, x, seed):
+            a = pl.pallas_call(kernel, grid=(4,))(seed, x)
+            # lint: shared-prng-stream
+            b = pl.pallas_call(kernel, grid=(4,))(seed, x)
+            return a + b
+
+        def not_a_seed(kernel, x):
+            a = pl.pallas_call(kernel, grid=(4,))(x)
+            b = pl.pallas_call(kernel, grid=(4,))(x)
+            return a + b
+        """,
+        select=["prng-key-reuse"],
+    )
+    assert rule_names(vs) == ["prng-key-reuse"]
+    assert vs[0].line == 7 and "second pallas_call" in vs[0].message
+
+
 # ---------------------------------------------------------------------------
 # dead-flag
 # ---------------------------------------------------------------------------

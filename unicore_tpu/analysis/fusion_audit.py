@@ -1,0 +1,214 @@
+"""Operation-fusion audit of compiled XLA programs (``--fusion-audit``).
+
+Per "LLM Inference Acceleration via Efficient Operation Fusion" (PAPERS.md,
+arXiv 2502.17728), the wins the device-side kernel suite claims — fewer
+kernels, elementwise chains folded into their producers — are PROGRAM
+STRUCTURE properties, checkable without a device: compile the train step,
+walk the optimized HLO, and report
+
+- **kernel count**: schedulable instructions (everything an executor
+  launches — parameters/constants/tuple plumbing excluded),
+- **fusion count** (+ per-``kind`` breakdown) and **bytes touched** per
+  fused region (operand + result bytes — the HBM traffic one fused launch
+  replaces N unfused launches of),
+- the **top-N unfused elementwise chains**: connected groups of elementwise
+  ops still sitting at computation level, i.e. fusion opportunities XLA
+  declined — the first place to look when a "fused" change didn't shrink
+  the program.
+
+The parser is text-based (``compiled.as_text()``) and intentionally
+tolerant: unknown shapes/opcodes degrade to zero-byte entries, never a
+crash — an audit must not take down a training run.  Numbers are exact for
+the common HLO shapes and are meant for BEFORE/AFTER comparison of the same
+model, not cross-backend absolutes.
+
+``trainer.fusion_audit()`` journals the report through the telemetry plane
+(kind ``fusion-audit``) and logs it as one BENCH-comparable JSON block.
+"""
+
+import json
+import re
+from typing import Dict, List, Optional
+
+#: dtype prefix -> bytes per element (unknown prefixes parse as 0)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: opcodes that never launch device work on their own
+_NON_KERNEL_OPS = frozenset({
+    "parameter", "constant", "tuple", "get-tuple-element", "iota",
+    "after-all", "partition-id", "replica-id",
+})
+
+#: elementwise HLO opcodes (the fusible-by-definition set)
+_ELEMENTWISE_OPS = frozenset({
+    "abs", "add", "and", "atan2", "cbrt", "ceil", "clamp", "compare",
+    "convert", "cosine", "divide", "exponential", "exponential-minus-one",
+    "floor", "is-finite", "log", "log-plus-one", "logistic", "maximum",
+    "minimum", "multiply", "negate", "not", "or", "popcnt", "power",
+    "remainder", "round-nearest-afz", "round-nearest-even", "rsqrt",
+    "select", "shift-left", "shift-right-arithmetic", "shift-right-logical",
+    "sign", "sine", "sqrt", "subtract", "tan", "tanh", "xor",
+})
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*(?:e\d+m\d+(?:fn)?)?)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\([^=]*?\)|\S+)\s+([\w\-]+)\("
+)
+_COMP_RE = re.compile(r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s+\([^)]*\)\s*->.*{\s*$")
+_CALLED_RE = re.compile(r"(?:calls|to_apply)=%([\w.\-]+)")
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _shape_bytes(text: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape literal in ``text``."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        per = _DTYPE_BYTES.get(dtype, 0)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += per * n
+    return total
+
+
+def _split_computations(hlo: str) -> List[dict]:
+    """[{name, entry, lines}] per computation in the module text."""
+    comps, cur = [], None
+    for line in hlo.splitlines():
+        m = _COMP_RE.match(line)
+        if m and cur is None:
+            cur = {"name": m.group(2), "entry": bool(m.group(1)), "lines": []}
+            continue
+        if cur is not None:
+            if line.strip() == "}":
+                comps.append(cur)
+                cur = None
+            else:
+                cur["lines"].append(line)
+    return comps
+
+
+def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
+    """Walk one optimized HLO module; return the audit report dict."""
+    comps = _split_computations(hlo)
+    # computations referenced via calls=/to_apply= are bodies of their
+    # caller (fusion regions, reduce combiners): their instructions are
+    # already accounted for at the call site
+    called = set()
+    for c in comps:
+        for line in c["lines"]:
+            called.update(_CALLED_RE.findall(line))
+
+    kernels = 0
+    instructions = 0
+    fusions = []
+    fusion_kinds: Dict[str, int] = {}
+    chains: List[Dict] = []
+
+    for comp in comps:
+        if comp["name"] in called:
+            continue
+        instrs = []  # (name, opcode, line)
+        for line in comp["lines"]:
+            m = _INSTR_RE.match(line)
+            if not m:
+                continue
+            name, _shape, opcode = m.groups()
+            instrs.append((name, opcode, line))
+            instructions += 1
+            if opcode not in _NON_KERNEL_OPS:
+                kernels += 1
+            if opcode == "fusion":
+                km = re.search(r"kind=(\w+)", line)
+                kind = km.group(1) if km else "unknown"
+                fusion_kinds[kind] = fusion_kinds.get(kind, 0) + 1
+                fusions.append({
+                    "name": name,
+                    "kind": kind,
+                    "bytes": _shape_bytes(line.split(", kind=")[0]),
+                })
+        chains.extend(_elementwise_chains(instrs))
+
+    fusions.sort(key=lambda f: -f["bytes"])
+    chains.sort(key=lambda c: -c["length"])
+    return {
+        "instructions": instructions,
+        "kernels": kernels,
+        "fusions": len(fusions),
+        "fusion_kinds": fusion_kinds,
+        "fused_bytes_total": sum(f["bytes"] for f in fusions),
+        "top_fusions": fusions[:top_n],
+        "unfused_elementwise": sum(c["length"] for c in chains),
+        "top_unfused_chains": chains[:top_n],
+    }
+
+
+def _elementwise_chains(instrs) -> List[Dict]:
+    """Connected groups of computation-level elementwise instructions —
+    each one is a fusion XLA declined (or was legally barred from)."""
+    elem = {name: (opcode, line) for name, opcode, line in instrs
+            if opcode in _ELEMENTWISE_OPS}
+    if not elem:
+        return []
+    # undirected adjacency over def-use edges between elementwise ops
+    adj: Dict[str, set] = {n: set() for n in elem}
+    for name, (_op, line) in elem.items():
+        # operands: names inside the outermost call parens
+        paren = line[line.index("(") + 1:]
+        for ref in _OPERAND_RE.findall(paren):
+            if ref in elem and ref != name:
+                adj[name].add(ref)
+                adj[ref].add(name)
+    seen, out = set(), []
+    for start in elem:
+        if start in seen:
+            continue
+        stack, comp = [start], []
+        seen.add(start)
+        while stack:
+            n = stack.pop()
+            comp.append(n)
+            for nb in adj[n]:
+                if nb not in seen:
+                    seen.add(nb)
+                    stack.append(nb)
+        out.append({
+            "length": len(comp),
+            "ops": sorted(elem[n][0] for n in comp),
+        })
+    return out
+
+
+def audit_compiled(compiled, top_n: int = 5) -> Optional[Dict]:
+    """Audit a ``jax`` compiled executable (``lowered.compile()`` result).
+    Adds the compiler's own memory analysis when available.  Returns None
+    when the executable exposes no HLO text (audits must never raise)."""
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        return None
+    if not hlo:
+        return None
+    report = audit_hlo(hlo, top_n=top_n)
+    try:
+        mem = compiled.memory_analysis()
+        report["memory"] = {
+            "argument_bytes": int(mem.argument_size_in_bytes),
+            "output_bytes": int(mem.output_size_in_bytes),
+            "temp_bytes": int(mem.temp_size_in_bytes),
+        }
+    except Exception:
+        pass
+    return report
+
+
+def format_report(report: Dict) -> str:
+    """One grep-able JSON block (the BENCH-comparable form)."""
+    return "FUSION-AUDIT " + json.dumps(report, sort_keys=True)

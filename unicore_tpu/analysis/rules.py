@@ -7,7 +7,7 @@ examples, and the justification-comment escape hatches.
 
 import ast
 import os
-from typing import Iterator, Optional, Set
+from typing import Dict, Iterator, Optional, Set
 
 from unicore_tpu.analysis.core import (
     LintRule,
@@ -575,16 +575,25 @@ _PRNG_CONSUMERS = frozenset(
 @register_lint_rule("prng-key-reuse")
 class PrngKeyReuse(LintRule):
     name = "prng-key-reuse"
+    justifications = ("shared-prng-stream", "single-block-grid")
     description = (
         "the same PRNGKey variable consumed by two random primitives "
         "without an intervening split/fold_in — the draws are identical, "
-        "silently correlating what should be independent randomness"
+        "silently correlating what should be independent randomness.  "
+        "Also covers Pallas in-kernel seeding: a pltpu.prng_seed whose "
+        "seed operand is loop-invariant across grid steps (every block "
+        "draws the same bits — the constant-seed ring-kernel bug class), "
+        "and one seed variable fed to two pallas_calls in one function "
+        "(two kernels share one stream; fwd/bwd mask recompute justifies "
+        "with '# lint: shared-prng-stream')"
     )
 
     def check(self, module: ModuleInfo) -> Iterator[Violation]:
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 yield from self._check_function(module, node)
+                yield from self._check_kernel_seeding(module, node)
+                yield from self._check_pallas_seed_reuse(module, node)
 
     def _check_function(self, module: ModuleInfo, fn) -> Iterator[Violation]:
         # (line, col, kind, name, node, branch-context); contexts make
@@ -672,6 +681,117 @@ class PrngKeyReuse(LintRule):
             for el in t.elts:
                 if isinstance(el, ast.Name):
                     yield el.id
+
+    # -- Pallas in-kernel seeding ---------------------------------------
+
+    def _check_kernel_seeding(self, module: ModuleInfo, fn) -> Iterator[Violation]:
+        """Flag ``pltpu.prng_seed(seed)`` where ``seed`` provably cannot
+        vary across grid steps: its expression reaches only constants and
+        ``*_ref`` operands (the scalar-prefetch idiom) — no
+        ``pl.program_id``, no kernel parameter, no call.  Every block then
+        generates IDENTICAL random bits (the bug class behind the ring
+        kernel's constant-seed fix).  Kernels with a genuinely single-block
+        grid justify with '# lint: single-block-grid'."""
+        params = {
+            a.arg
+            for a in (
+                list(fn.args.posonlyargs) + list(fn.args.args)
+                + list(fn.args.kwonlyargs)
+            )
+        }
+        ref_params = {p for p in params if p.endswith("_ref")}
+        assigns: Dict[str, list] = {}
+        for n in walk_body(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for name in self._target_names(t):
+                        assigns.setdefault(name, []).append(n.value)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                if getattr(n, "value", None) is not None:
+                    for name in self._target_names(n.target):
+                        assigns.setdefault(name, []).append(n.value)
+            elif isinstance(n, ast.For):
+                for name in self._target_names(n.target):
+                    assigns.setdefault(name, []).append(n.iter)
+        for n in walk_body(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            if terminal_name(n.func) != "prng_seed" or not n.args:
+                continue
+            if self._grid_invariant(
+                n.args[0], assigns, ref_params, params, set()
+            ):
+                yield _v(
+                    self,
+                    module,
+                    n,
+                    f"pltpu.prng_seed in '{fn.name}' takes a seed that is "
+                    "loop-invariant across grid steps (only constants / "
+                    "*_ref operands reach it): every block draws IDENTICAL "
+                    "random bits — mix pl.program_id coordinates into the "
+                    "seed, or justify a single-block grid with "
+                    "'# lint: single-block-grid'",
+                )
+
+    def _grid_invariant(self, expr, assigns, ref_params, params, visiting) -> bool:
+        """True when ``expr`` provably cannot vary with grid position.
+        Conservative: any call (program_id included) or unresolved name
+        counts as varying, so only the constant/ref-only shapes flag."""
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                return False
+            if isinstance(sub, ast.Name):
+                nm = sub.id
+                if nm in ref_params or nm in visiting:
+                    continue
+                if nm in params:
+                    return False
+                values = assigns.get(nm)
+                if not values:
+                    return False  # global/builtin: assume varying
+                visiting.add(nm)
+                ok = all(
+                    self._grid_invariant(v, assigns, ref_params, params,
+                                         visiting)
+                    for v in values
+                )
+                visiting.discard(nm)
+                if not ok:
+                    return False
+        return True
+
+    def _check_pallas_seed_reuse(self, module: ModuleInfo, fn) -> Iterator[Violation]:
+        """Flag one seed variable passed (as the scalar-prefetch operand)
+        to TWO pallas_call invocations in one function: both kernels seed
+        identical streams.  Intentional sharing — the backward regenerating
+        the forward's dropout mask — justifies with
+        '# lint: shared-prng-stream'."""
+        seen: Dict[str, ast.Call] = {}
+        calls = [
+            n for n in walk_body(fn)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Call)
+            and (terminal_name(n.func.func) or "").endswith("pallas_call")
+        ]
+        for n in sorted(calls, key=lambda c: (c.lineno, c.col_offset)):
+            if not n.args or not isinstance(n.args[0], ast.Name):
+                continue
+            name = n.args[0].id
+            if "seed" not in name.lower():
+                continue  # first operand is only a seed by convention
+            if name in seen:
+                yield _v(
+                    self,
+                    module,
+                    n,
+                    f"seed '{name}' feeds a second pallas_call in "
+                    f"'{fn.name}': both kernels generate IDENTICAL PRNG "
+                    "streams — fold a kernel id into the seed, or justify "
+                    "deliberate fwd/bwd mask recompute with "
+                    "'# lint: shared-prng-stream'",
+                )
+            else:
+                seen[name] = n
 
     def _consumed_key(self, module: ModuleInfo, call: ast.Call) -> Optional[str]:
         """Variable name of the key this call consumes, if any."""

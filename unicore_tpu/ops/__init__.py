@@ -10,6 +10,10 @@ consumers.
 """
 
 from . import flash_attention  # noqa  (module, not the function)
-from .softmax_dropout import softmax_dropout  # noqa
+from .softmax_dropout import (  # noqa
+    set_softmax_dropout_mode,
+    softmax_dropout,
+    softmax_dropout_reference,
+)
 from .rounding import fp32_to_bf16_sr, tree_fp32_to_bf16_sr  # noqa
 from .fused_norm import fused_layer_norm, fused_rms_norm  # noqa

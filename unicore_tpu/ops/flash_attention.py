@@ -515,6 +515,9 @@ def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q,
             has_bias=has_bias, has_mask=has_mask,
         )
 
+    # dkv regenerates the SAME dropout mask the forward applied
+    # (recompute-from-counters design, module docstring)
+    # lint: shared-prng-stream
     dk, dv = _pallas_call(
         dkv_wrapped,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -587,7 +590,9 @@ def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q,
                 has_bias=has_bias, has_mask=has_mask,
             )
 
-        # Hb == 1: the kernel writes per-head grads; reduced below
+        # Hb == 1: the kernel writes per-head grads; reduced below.
+        # dbias regenerates the forward's mask (recompute design)
+        # lint: shared-prng-stream
         dbias_full = _pallas_call(
             db_wrapped,
             grid_spec=pltpu.PrefetchScalarGridSpec(

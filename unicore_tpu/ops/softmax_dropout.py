@@ -1,24 +1,65 @@
-"""Fused softmax(+mask)(+bias)(+dropout).
+"""Fused softmax(+mask)(+bias)(+dropout) — dispatch + jnp oracle.
 
 TPU-native counterpart of the reference's ``unicore_fused_softmax_dropout``
 CUDA extension (/root/reference/csrc/softmax_dropout/ and
 unicore/modules/softmax_dropout.py): the same op surface — optional additive
 mask and bias with the reference's broadcast semantics (_check_mask /
-_check_bias, softmax_dropout.py:53-97) — implemented as a jnp composition that
-XLA fuses into a single kernel on TPU.  The softmax runs in fp32 regardless of
-input dtype (matching the CUDA kernel's accumulator) and the dropout mask is
-never materialized in HBM separately from the fused computation.
+_check_bias, softmax_dropout.py:53-97).  Two implementations share it:
+
+- the **jnp composition** below (the oracle and the universal fallback):
+  XLA fuses the softmax chain well, but training-mode dropout pays a
+  separate ``jax.random.bernoulli`` pass whose mask round-trips HBM;
+- the **Pallas kernel** (ops/softmax_dropout_pallas.py): in-kernel
+  counter-based PRNG hidden behind the row compute, recomputed — never
+  stored — in the backward.
+
+``softmax_dropout`` dispatches between them by backend and shape so callers
+(modules/multihead_attention.py, modules/evoformer.py) change zero lines:
+
+- mode ``auto`` (default): Pallas on a real TPU backend when
+  ``pallas_plan`` accepts the geometry (last dim a 128-multiple <= 8192,
+  rows a multiple of 8, fp32/bf16, expressible mask/bias layout); jnp
+  everywhere else.  CPU/interpret stays on the jnp path so numerics of
+  existing CPU runs are bit-identical to before.
+- mode ``on``: Pallas whenever the geometry allows — used by the parity
+  tests and benchmarks (with ops._pallas interpret mode on CPU).
+- mode ``off``: always jnp.
+
+Set via :func:`set_softmax_dropout_mode` or the
+``UNICORE_TPU_PALLAS_SOFTMAX_DROPOUT`` env var (``auto``/``on``/``off``,
+plus legacy ``0``/``1``).  The softmax runs in fp32 regardless of input
+dtype (matching the CUDA kernel's accumulator) on BOTH paths.
 
 This op is the API for modules that need materialized probabilities
 (``return_attn`` consumers like Uni-Fold's triangle attention); the memory-
-bound long-sequence cases are covered by the Pallas flash-attention kernel
-in ops/ once present.
+bound long-sequence cases are covered by the Pallas flash-attention kernel.
 """
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+_MODES = ("auto", "on", "off")
+_mode = None  # resolved lazily: env var > set_softmax_dropout_mode > auto
+
+
+def set_softmax_dropout_mode(mode: Optional[str]):
+    """Select the dispatch mode (``auto``/``on``/``off``; None = auto)."""
+    global _mode
+    if mode is not None and mode not in _MODES:
+        raise ValueError(f"softmax_dropout mode {mode!r} not in {_MODES}")
+    _mode = mode
+
+
+def _resolved_mode() -> str:
+    env = os.environ.get("UNICORE_TPU_PALLAS_SOFTMAX_DROPOUT")
+    if env is not None:
+        if env in _MODES:
+            return env
+        return "off" if env in ("0", "false", "") else "on"
+    return _mode or "auto"
 
 
 def _broadcastable_to(shape, target):
@@ -54,6 +95,46 @@ def _expand_extra(x: jnp.ndarray, input_shape) -> Optional[jnp.ndarray]:
     )
 
 
+def softmax_dropout_reference(
+    input: jnp.ndarray,
+    dropout_prob: float,
+    is_training: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """The jnp composition — the numerics oracle and universal fallback."""
+    dtype = input.dtype
+    x = input.astype(jnp.float32)
+    if mask is not None:
+        x = x + _expand_extra(mask.astype(jnp.float32), x.shape)
+    if bias is not None:
+        x = x + _expand_extra(bias.astype(jnp.float32), x.shape)
+    probs = jax.nn.softmax(x, axis=-1)
+    probs = probs.astype(dtype)
+    if is_training and dropout_prob > 0.0:
+        if dropout_rng is None:
+            raise ValueError(
+                "softmax_dropout needs dropout_rng when training with dropout"
+            )
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0).astype(dtype)
+    return probs
+
+
+def _pallas_eligible(input, mask, bias) -> Optional[tuple]:
+    """Return the static kernel plan when the dispatch mode + backend +
+    geometry allow the Pallas path, else None."""
+    mode = _resolved_mode()
+    if mode == "off":
+        return None
+    if mode == "auto" and jax.default_backend() != "tpu":
+        return None
+    from .softmax_dropout_pallas import pallas_plan
+
+    return pallas_plan(tuple(input.shape), input.dtype, mask, bias)
+
+
 def softmax_dropout(
     input: jnp.ndarray,
     dropout_prob: float,
@@ -68,17 +149,27 @@ def softmax_dropout(
     Mirrors reference modules/softmax_dropout.py:100-144.  ``dropout_rng`` is
     required when ``is_training and dropout_prob > 0``.
     """
-    dtype = input.dtype
-    x = input.astype(jnp.float32)
-    if mask is not None:
-        x = x + _expand_extra(mask.astype(jnp.float32), x.shape)
-    if bias is not None:
-        x = x + _expand_extra(bias.astype(jnp.float32), x.shape)
-    probs = jax.nn.softmax(x, axis=-1)
-    probs = probs.astype(dtype)
-    if is_training and dropout_prob > 0.0:
-        if dropout_rng is None:
-            raise ValueError("softmax_dropout needs dropout_rng when training with dropout")
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0).astype(dtype)
-    return probs
+    training_dropout = is_training and dropout_prob > 0.0
+    if training_dropout and dropout_rng is None:
+        raise ValueError(
+            "softmax_dropout needs dropout_rng when training with dropout"
+        )
+    plans = _pallas_eligible(input, mask, bias)
+    if plans is not None:
+        from .softmax_dropout_pallas import softmax_dropout_pallas
+
+        seed = 0
+        if training_dropout:
+            # the key is consumed exactly once, into the kernel's int32
+            # stream id (mixed with block coordinates in-kernel)
+            seed = jax.random.randint(
+                dropout_rng, (), 0, 2 ** 31 - 1, dtype=jnp.int32
+            )
+        return softmax_dropout_pallas(
+            input, dropout_prob, is_training=is_training,
+            mask=mask, bias=bias, seed=seed, plans=plans,
+        )
+    return softmax_dropout_reference(
+        input, dropout_prob, is_training=is_training,
+        mask=mask, bias=bias, dropout_rng=dropout_rng,
+    )

@@ -3,9 +3,17 @@ csrc/adam/adam_kernel.cu).
 
 AdamW semantics matching the fused CUDA kernel: fp32 moments, bias correction
 folded into the step size, decoupled weight decay applied as
-``p *= (1 - lr * wd)`` (adam_kernel.cu:17-46).  XLA fuses the whole pytree
-update into a handful of kernels — the multi-tensor-apply machinery the
-reference needs has no TPU counterpart to build.
+``p *= (1 - lr * wd)`` (adam_kernel.cu:17-46).  Two equivalent update paths:
+
+- the default tree_map path: XLA fuses the per-leaf updates, but the program
+  carries O(leaves) HLO ops;
+- ``--fused-adam``: the ``multi_tensor_apply`` idiom
+  (optim/multi_tensor.py) — grads/moments/master flattened into
+  dtype-homogeneous flat buffers, global grad-norm + clip + moment update +
+  weight decay as one pass per buffer, bf16-SR write-back on buffers.
+  Bit-identical to the tree_map path in fp32 (the grad-norm and the SR
+  random stream differ at documented, bounded levels —
+  docs/performance.md).
 """
 
 import jax
@@ -41,6 +49,18 @@ class Adam(UnicoreOptimizer):
             metavar="WD",
             help="weight decay",
         )
+        parser.add_argument(
+            "--fused-adam",
+            action="store_true",
+            help="multi-tensor Adam: run grad-norm/clip/moments/decay as one "
+            "fused pass per dtype-homogeneous flat buffer instead of "
+            "O(leaves) per-leaf ops (optim/multi_tensor.py; bit-identical "
+            "update in fp32, see docs/performance.md)",
+        )
+
+    @property
+    def use_fused(self):
+        return bool(getattr(self.args, "fused_adam", False))
 
     @property
     def betas(self):
@@ -64,10 +84,34 @@ class Adam(UnicoreOptimizer):
             "v": jax.tree_util.tree_map(zeros, master_params),
         }
 
+    def clip_grad_norm(self, grads, max_norm):
+        if self.use_fused:
+            from . import multi_tensor
+
+            return multi_tensor.clip_grad_norm(grads, max_norm)
+        return super().clip_grad_norm(grads, max_norm)
+
+    def _copy_back(self, new_master, params, sr_rng):
+        if self.use_fused:
+            from . import multi_tensor
+
+            return multi_tensor.fused_copy_back(
+                new_master, params, sr_rng,
+                bf16_sr=bool(getattr(self.args, "bf16_sr", False)),
+            )
+        return super()._copy_back(new_master, params, sr_rng)
+
     def _apply_update(self, grads32, slots, master, lr, step, decay_mask):
         beta1, beta2 = self.betas
         eps = self.eps
         wd = self.weight_decay
+        if self.use_fused:
+            from . import multi_tensor
+
+            return multi_tensor.fused_adam_update(
+                grads32, slots, master, lr, step, decay_mask,
+                beta1=beta1, beta2=beta2, eps=eps, weight_decay=wd,
+            )
         stepf = step.astype(jnp.float32)
         bc1 = 1.0 - beta1 ** stepf
         bc2 = 1.0 - beta2 ** stepf

@@ -1,0 +1,212 @@
+"""Fused multi-tensor optimizer arithmetic (the ``multi_tensor_apply`` idiom).
+
+TPU-native counterpart of the reference's ``unicore_fused_adam`` +
+``unicore_fused_multi_tensor`` CUDA extensions (/root/reference/csrc/adam/,
+csrc/multi_tensor/): instead of walking the parameter pytree leaf by leaf —
+O(leaves) HLO ops that XLA must re-fuse every compile, and O(leaves) kernels
+when it declines — the grad/m/v/master trees are raveled into a handful of
+dtype-homogeneous FLAT BUFFERS and the whole global-L2-norm + clip + Adam
+moment update + decoupled weight decay sequence runs as one elementwise pass
+per buffer.  The segment table (:class:`FlatPlan`) is built once per tree
+STRUCTURE and memoized — the per-step cost is the concatenate, which XLA
+lowers to views into one allocation.
+
+Numerics contract (tests/test_multi_tensor.py):
+
+- the fused Adam update is **bit-identical in fp32** to the tree_map path in
+  :class:`~unicore_tpu.optim.adam.Adam` — the per-element op sequence is
+  unchanged, only the iteration space is flattened;
+- the fused global grad-norm may differ from ``utils.total_norm`` in the
+  last ulp (one tree-ordered scalar sum vs one per-buffer reduction), so the
+  clip coefficient — and anything downstream — is equal only to ~1e-7
+  relative; documented in docs/performance.md;
+- the bf16 stochastic-rounding write-back (reusing
+  :func:`unicore_tpu.ops.rounding.fp32_to_bf16_sr`) draws ONE key per flat
+  buffer instead of one per leaf: same unbiased-rounding guarantee, a
+  different random stream than the tree path (divergence bounded by 1 bf16
+  ulp per element).
+
+ZeRO-1 compatibility: the optimizer STATE stays a per-leaf pytree (same
+checkpoint format, same ``zero1_pspecs`` sharding tree); flattening happens
+inside the jitted step, where GSPMD propagates the sharded layouts through
+the concatenate.
+"""
+
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.ops.rounding import fp32_to_bf16_sr
+
+
+class _Group(NamedTuple):
+    dtype: Any
+    indices: Tuple[int, ...]   # flat-leaf indices in tree_flatten order
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+
+
+class FlatPlan(NamedTuple):
+    treedef: Any
+    groups: Tuple[_Group, ...]
+    n_leaves: int
+
+
+def build_plan(tree) -> FlatPlan:
+    """Segment table for one pytree: leaves grouped by dtype, order-stable
+    within each group (tree_flatten order)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    groups = []
+    for dtype, idxs in by_dtype.items():
+        shapes = tuple(tuple(leaves[i].shape) for i in idxs)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        groups.append(_Group(dtype, tuple(idxs), shapes, sizes))
+    return FlatPlan(treedef, tuple(groups), len(leaves))
+
+
+_PLAN_MEMO: Dict[Any, FlatPlan] = {}
+
+
+def plan_for(tree) -> FlatPlan:
+    """Memoized :func:`build_plan` keyed by (structure, shapes, dtypes) —
+    the once-at-init half of multi_tensor_apply."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    key = (treedef, tuple((tuple(l.shape), jnp.asarray(l).dtype) for l in leaves))
+    plan = _PLAN_MEMO.get(key)
+    if plan is None:
+        plan = build_plan(tree)
+        _PLAN_MEMO[key] = plan
+    return plan
+
+
+def flatten(plan: FlatPlan, tree) -> List[jnp.ndarray]:
+    """One 1-D buffer per dtype group (ravel + concatenate)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    bufs = []
+    for g in plan.groups:
+        parts = [jnp.ravel(leaves[i]) for i in g.indices]
+        bufs.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    return bufs
+
+
+def unflatten(plan: FlatPlan, bufs: List[jnp.ndarray]):
+    """Inverse of :func:`flatten` (slicing lowers to views)."""
+    leaves: List[Any] = [None] * plan.n_leaves
+    for g, buf in zip(plan.groups, bufs):
+        off = 0
+        for i, shape, size in zip(g.indices, g.shapes, g.sizes):
+            leaves[i] = jax.lax.slice(buf, (off,), (off + size,)).reshape(shape)
+            off += size
+    return jax.tree_util.tree_unflatten(plan.treedef, leaves)
+
+
+def bool_buffers(plan: FlatPlan, mask_tree) -> List[jnp.ndarray]:
+    """Flat per-group bool buffers from a static python-bool mask tree (the
+    decay mask) — materialized as numpy constants, folded at compile."""
+    leaves = jax.tree_util.tree_leaves(mask_tree)
+    bufs = []
+    for g in plan.groups:
+        parts = [
+            np.full((size,), bool(leaves[i]), dtype=bool)
+            for i, size in zip(g.indices, g.sizes)
+        ]
+        bufs.append(jnp.asarray(np.concatenate(parts)))
+    return bufs
+
+
+# ---------------------------------------------------------------------------
+# fused passes
+# ---------------------------------------------------------------------------
+
+def multi_tensor_l2norm(bufs: List[jnp.ndarray]) -> jnp.ndarray:
+    """Global L2 norm over flat buffers: ONE reduction per buffer (the
+    reference's ``multi_tensor_l2norm`` kernel)."""
+    if not bufs:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(b.astype(jnp.float32))) for b in bufs)
+    return jnp.sqrt(sq)
+
+
+def clip_grad_norm(grads, max_norm: float, eps: float = 1e-6):
+    """Fused global-norm clip: same contract as ``utils.clip_grad_norm``
+    (returns ``(clipped, gnorm)``; branchless, ``max_norm <= 0`` = no clip).
+    The norm reduces per flat buffer, so it can differ from the tree-ordered
+    ``utils.total_norm`` in the final ulp (documented)."""
+    plan = plan_for(grads)
+    bufs = flatten(plan, grads)
+    gnorm = multi_tensor_l2norm(bufs)
+    max_norm = jnp.asarray(max_norm, dtype=gnorm.dtype)
+    clip_coef = jnp.where(
+        max_norm > 0, jnp.minimum(max_norm / (gnorm + eps), 1.0), 1.0
+    )
+    clipped = [
+        (b.astype(jnp.float32) * clip_coef).astype(b.dtype) for b in bufs
+    ]
+    return unflatten(plan, clipped), gnorm
+
+
+def fused_adam_update(
+    grads32, slots, master, lr, step, decay_mask,
+    *, beta1: float, beta2: float, eps: float, weight_decay: float,
+):
+    """One fused Adam(W) pass per flat buffer — per-element math identical
+    to the tree_map path in :class:`~unicore_tpu.optim.adam.Adam`
+    (bit-parity proven in tests/test_multi_tensor.py)."""
+    plan = plan_for(grads32)
+    g_bufs = flatten(plan, grads32)
+    m_bufs = flatten(plan, slots["m"])
+    v_bufs = flatten(plan, slots["v"])
+    p_bufs = flatten(plan, master)
+    d_bufs = bool_buffers(plan, decay_mask)
+
+    stepf = step.astype(jnp.float32)
+    bc1 = 1.0 - beta1 ** stepf
+    bc2 = 1.0 - beta2 ** stepf
+    step_size = lr * jnp.sqrt(bc2) / bc1
+
+    new_p, new_m, new_v = [], [], []
+    for g, m, v, p, d in zip(g_bufs, m_bufs, v_bufs, p_bufs, d_bufs):
+        if weight_decay != 0.0:
+            p = jnp.where(d, p * (1.0 - step_size * weight_decay), p)
+        m = beta1 * m + (1.0 - beta1) * g
+        v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+        update = m / (jnp.sqrt(v) + eps)
+        p = p - step_size * update
+        new_p.append(p)
+        new_m.append(m)
+        new_v.append(v)
+    return unflatten(plan, new_p), {
+        "m": unflatten(plan, new_m),
+        "v": unflatten(plan, new_v),
+    }
+
+
+def fused_copy_back(new_master, params, sr_rng, bf16_sr: bool):
+    """master->param copy-back on flat buffers, grouped by TARGET dtype.
+
+    With ``bf16_sr``, bf16 targets get stochastic rounding via
+    ``ops/rounding.py`` with ONE key per buffer (the tree path draws one per
+    leaf — a different stream, same unbiased guarantee; divergence bounded
+    by 1 bf16 ulp per element)."""
+    # plan over the TARGET dtypes so each buffer casts uniformly (master
+    # leaves are gathered into the param-plan's segment order)
+    plan = plan_for(params)
+    bufs = flatten(plan, new_master)
+    use_sr = bf16_sr and sr_rng is not None
+    keys = (
+        jax.random.split(sr_rng, len(plan.groups)) if use_sr else
+        [None] * len(plan.groups)
+    )
+    out_bufs = []
+    for g, buf, key in zip(plan.groups, bufs, keys):
+        if use_sr and g.dtype == jnp.bfloat16 and buf.dtype == jnp.float32:
+            out_bufs.append(fp32_to_bf16_sr(buf, key))
+        else:
+            out_bufs.append(buf.astype(g.dtype))
+    return unflatten(plan, out_bufs)

@@ -94,6 +94,27 @@ class UnicoreOptimizer(object):
         """Pure fp32 update: returns (new_master, new_slots)."""
         raise NotImplementedError
 
+    def _copy_back(self, new_master, params, sr_rng):
+        """master -> low-precision param copy-back, optionally with
+        stochastic rounding (per-leaf keys).  Subclasses with a fused flat
+        path (optim/multi_tensor.py) override this to round per buffer."""
+        if getattr(self.args, "bf16_sr", False) and sr_rng is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(new_master)
+            keys = jax.random.split(sr_rng, len(leaves))
+            tmpl = jax.tree_util.tree_leaves(params)
+            return jax.tree_util.tree_unflatten(
+                treedef,
+                [
+                    fp32_to_bf16_sr(m, k)
+                    if t.dtype == jnp.bfloat16
+                    else m.astype(t.dtype)
+                    for m, k, t in zip(leaves, keys, tmpl)
+                ],
+            )
+        return jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), new_master, params
+        )
+
     # ------------------------------------------------------------------
 
     def init_state(self, params) -> Dict[str, Any]:
@@ -163,24 +184,7 @@ class UnicoreOptimizer(object):
             step = jnp.where(skip_update, state["step"], step)
 
         if state["master"] is not None:
-            # master -> low-precision copy-back, optionally with SR
-            if getattr(self.args, "bf16_sr", False) and sr_rng is not None:
-                leaves, treedef = jax.tree_util.tree_flatten(new_master)
-                keys = jax.random.split(sr_rng, len(leaves))
-                tmpl = jax.tree_util.tree_leaves(params)
-                new_params = jax.tree_util.tree_unflatten(
-                    treedef,
-                    [
-                        fp32_to_bf16_sr(m, k)
-                        if t.dtype == jnp.bfloat16
-                        else m.astype(t.dtype)
-                        for m, k, t in zip(leaves, keys, tmpl)
-                    ],
-                )
-            else:
-                new_params = jax.tree_util.tree_map(
-                    lambda m, p: m.astype(p.dtype), new_master, params
-                )
+            new_params = self._copy_back(new_master, params, sr_rng)
             new_state = {"step": step, "master": new_master, "slots": new_slots}
         else:
             new_params = new_master

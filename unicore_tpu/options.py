@@ -372,6 +372,24 @@ def get_parser(desc, default_task=None):
                              "naming the update and program count "
                              "(0 disables the warning; the 'recompiles' "
                              "metric is always reported)")
+    parser.add_argument("--fusion-audit", action="store_true",
+                        help="after the first update, compile-audit the "
+                             "train step's optimized HLO (kernel count, "
+                             "fusion count, bytes per fused region, top "
+                             "unfused elementwise chains) and journal one "
+                             "FUSION-AUDIT JSON block through telemetry — "
+                             "program-structure regressions are caught "
+                             "without a device (docs/performance.md)")
+    parser.add_argument("--fused-norm", default="auto",
+                        choices=["auto", "on", "off"],
+                        help="LayerNorm/RMSNorm kernel selection: 'on' = "
+                             "Pallas fused kernels (ops/fused_norm.py), "
+                             "'off' = jnp, 'auto' = jnp (XLA's norm fusion "
+                             "measures faster end-to-end; the kernel exists "
+                             "for parity benchmarking and shapes where XLA "
+                             "falls over).  Each module instance journals "
+                             "its chosen path once via telemetry "
+                             "(docs/performance.md)")
     parser.add_argument("--ema-decay", default=-1.0, type=float,
                         help="enable moving average for model parameters")
     parser.add_argument("--validate-with-ema", action="store_true")

@@ -157,6 +157,7 @@ class Trainer(object):
         # update counter is already past --compile-warmup-updates
         self._updates_this_process = 0
         self._active_prefetcher = None
+        self._fusion_audit_done = False
 
         self._start_time = time.time()
         self._previous_training_time = 0
@@ -443,7 +444,10 @@ class Trainer(object):
 
         clip_norm = getattr(self.args, "clip_norm", 0.0) or 0.0
         with jax.named_scope("clip-grads"):
-            grads, gnorm = utils.clip_grad_norm(grads, clip_norm)
+            # routed through the optimizer so --fused-adam folds the global
+            # norm + clip into the multi-tensor flat-buffer pass (the
+            # default delegates straight to utils.clip_grad_norm)
+            grads, gnorm = self._optimizer.clip_grad_norm(grads, clip_norm)
 
         overflow = ~jnp.isfinite(gnorm)
         pinned = jnp.zeros((), dtype=jnp.bool_)
@@ -813,6 +817,7 @@ class Trainer(object):
 
         state = self._state
         n = len(samples)
+        audit_args = None  # (sample, weight) for the one-shot --fusion-audit
 
         with self._oom_guard(samples[0]):
             if prepared is not None:
@@ -839,6 +844,7 @@ class Trainer(object):
                 new_state, self._macc = self._get_jit("train_step")(
                     state, sample, self._step_scalars(0, weight), self._macc
                 )
+                audit_args = (sample, weight)
             else:
                 if plan is not None and plan[0] is not None:
                     modes, sigs, stop_flags = plan
@@ -893,6 +899,22 @@ class Trainer(object):
         # one appears past --compile-warmup-updates (unstable geometry)
         self._updates_this_process += 1
         self._watch_recompiles()
+        # --fusion-audit: one-shot optimized-HLO walk of the train step
+        # (kernel/fusion counts, bytes per fused region), journaled via
+        # telemetry — program-structure regressions caught without a device
+        if (
+            getattr(self.args, "fusion_audit", False)
+            and not self._fusion_audit_done
+        ):
+            self._fusion_audit_done = True
+            if audit_args is not None:
+                self.fusion_audit(*audit_args)
+            else:
+                logger.warning(
+                    "fusion-audit: only the update-freq-1 synchronous train "
+                    "step is audited; this run dispatches a different "
+                    "program (prefetch/grad-accum) — audit skipped"
+                )
         # cross-host fingerprint check every --consistency-check-interval
         # updates (multi-host only; raises ConsistencyError naming the
         # divergent rank + field).  note_step feeds the watchdog's report.
@@ -1028,6 +1050,36 @@ class Trainer(object):
             itr.close()
         if self._active_prefetcher is itr:
             self._active_prefetcher = None
+
+    def fusion_audit(self, sample, weight=1.0, top_n: int = 5):
+        """Operation-fusion audit (``--fusion-audit``; arXiv 2502.17728,
+        PAPERS.md): AOT-compile the update-freq-1 train step against
+        ``sample``, walk the optimized HLO (analysis/fusion_audit.py), log
+        one grep-able ``FUSION-AUDIT`` JSON block and journal it as a
+        ``fusion-audit`` telemetry event.  Returns the report dict (None
+        when the program/HLO is unavailable — auditing never raises into
+        the training loop)."""
+        from unicore_tpu.analysis import fusion_audit as _fa
+
+        fn = self._jit_cache.get("train_step")
+        if fn is None:
+            logger.warning("fusion-audit: no compiled train_step program")
+            return None
+        try:
+            lowered = fn.lower(
+                self._state, sample, self._step_scalars(0, weight), self._macc
+            )
+            compiled = lowered.compile()
+        except Exception as e:
+            logger.warning(f"fusion-audit: compile failed: {e!r}")
+            return None
+        report = _fa.audit_compiled(compiled, top_n=top_n)
+        if report is None:
+            logger.warning("fusion-audit: executable exposes no HLO text")
+            return None
+        telemetry.emit("fusion-audit", **report)
+        logger.info(_fa.format_report(report))
+        return report
 
     #: jit-cache entries that make up the TRAIN step (valid_step compiles
     #: are expected at each new validation geometry and don't gate the

@@ -302,6 +302,12 @@ def main(args) -> None:
         args, rank=jax.process_index(),
         step_provider=trainer.get_num_updates, role="trainer",
     )
+    # --fused-norm: one documented flag drives LayerNorm/RMSNorm kernel
+    # selection (modules/layer_norm.py); each module instance journals its
+    # chosen path at trace time through the telemetry plane just configured
+    from unicore_tpu.modules.layer_norm import configure_fused_norm
+
+    configure_fused_norm(getattr(args, "fused_norm", "auto"))
     from unicore_tpu.telemetry import prometheus as _prom
 
     _prom.start_metrics_server(getattr(args, "metrics_port", 0) or 0)
