@@ -25,8 +25,20 @@ seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
                             kernels run in interpret mode and rows carry
                             "pallas_interpret": true (a correctness/
                             liveness proof, never a perf claim)
-    BENCH_CONFIG=all        run every config; one JSON line each, failures
-                            in one config don't lose the others' results
+    BENCH_CONFIG=memory     memory-headroom sweep: binary-search the max
+                            trainable parameter count per chip at fixed
+                            batch against a per-chip memory budget
+                            (BENCH_MEMORY_BUDGET_GB, default 2.0), using
+                            the compiled train program's OWN memory
+                            analysis — device-free, honest on CPU.  One
+                            row per {zero-stage} x {grad-accum} x
+                            {remat-policy} grid point
+                            (BENCH_MEMORY_STAGES/ACCUMS/REMATS trim the
+                            grid; docs/performance.md "Memory headroom")
+    BENCH_CONFIG=all        run every config except memory (its compile
+                            sweep has its own invocation); one JSON line
+                            each, failures in one config don't lose the
+                            others' results
 
 Prints ONE JSON line per config: {"metric", "value", "unit", "vs_baseline"}
 plus diagnostics: "ms_per_step", "mfu" (model-FLOPs utilization — FLOPs from
@@ -909,6 +921,160 @@ def run_pipeline_bench():
     return result
 
 
+# ---------------------------------------------------------------------------
+# memory-headroom mode (BENCH_CONFIG=memory): max trainable params per chip
+# ---------------------------------------------------------------------------
+
+def _memory_probe(stage, accum, remat, embed, vocab, batch, seq, uf):
+    """Compile (AOT, no training) the real train program for one config at
+    one model width; return (param_count, per-device peak_bytes from the
+    compiler's memory analysis)."""
+    from unicore_tpu.losses import LOSS_REGISTRY
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.tasks.unicore_task import UnicoreTask
+    from unicore_tpu.trainer import Trainer
+
+    args = _make_args()
+    args.zero_shard_optimizer = False
+    args.zero_stage = stage
+    args.grad_accum = accum
+    args.fused_adam = True
+    args.update_freq = [uf]
+    args.fusion_audit = False
+    args.no_weight_decay_names = ""
+
+    class _MemTask(UnicoreTask):
+        class _Dict:
+            def pad(self):
+                return 1
+
+        dictionary = _Dict()
+
+    task = _MemTask(args)
+    model = BertModel(
+        vocab_size=vocab, padding_idx=1, encoder_layers=2,
+        encoder_embed_dim=embed, encoder_ffn_embed_dim=4 * embed,
+        encoder_attention_heads=8, max_seq_len=seq, post_ln=True,
+        remat_policy=remat,
+    )
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(4, vocab, size=(batch, seq)).astype(np.int64)
+
+    def mk(i):
+        r = np.random.RandomState(i)
+        return {
+            "net_input": {"src_tokens": tokens},
+            "target": np.where(
+                r.rand(batch, seq) < 0.15, tokens, 1
+            ).astype(np.int64),
+        }
+
+    trainer = Trainer(args, task, model, LOSS_REGISTRY["masked_lm"](task))
+    trainer.init_state(mk(0))
+    n_params = sum(
+        int(np.prod(p.shape))
+        for p in __import__("jax").tree_util.tree_leaves(
+            trainer.state["params"]
+        )
+    )
+    if uf > 1:
+        trainer._get_jit(trainer._scan_jit_name())
+        stacked = trainer._try_stack_microbatches([mk(i) for i in range(uf)])
+        report = trainer.fusion_audit_scan(stacked)
+    else:
+        trainer._get_jit("train_step")
+        sample, weight = trainer._prepare_sample_or_dummy(mk(0))
+        report = trainer.fusion_audit(sample, weight)
+    if report is None or "memory" not in report:
+        raise RuntimeError("no memory analysis from the compiled program")
+    return n_params, report["memory"]["peak_bytes"]
+
+
+def run_memory_bench():
+    """Max trainable parameters per chip at fixed batch, per config: walk a
+    model-width ladder (exponential then bisect) until the compiled train
+    program's per-device peak allocation exceeds the budget.  The budget
+    is a dial (BENCH_MEMORY_BUDGET_GB): on CPU the row is a COMPARATIVE
+    headroom number across {zero-stage} x {grad-accum} x {remat}, never an
+    HBM claim — device_kind labels it like every other config."""
+    import jax
+
+    budget = float(os.environ.get("BENCH_MEMORY_BUDGET_GB", "2.0")) * 1024 ** 3
+    batch = int(os.environ.get("BENCH_MEMORY_BATCH", "8"))
+    seq = int(os.environ.get("BENCH_MEMORY_SEQ", "64"))
+    uf = int(os.environ.get("BENCH_MEMORY_UF", "2"))
+    vocab = int(os.environ.get("BENCH_MEMORY_VOCAB", "8192"))
+    stages = [int(s) for s in os.environ.get(
+        "BENCH_MEMORY_STAGES", "1,2,3").split(",") if s]
+    accums = [a for a in os.environ.get(
+        "BENCH_MEMORY_ACCUMS", "buffer,adama").split(",") if a]
+    remats = [r for r in os.environ.get(
+        "BENCH_MEMORY_REMATS", "none").split(",") if r]
+    ladder = [int(x) for x in os.environ.get(
+        "BENCH_MEMORY_LADDER",
+        "128,192,256,384,512,768,1024,1536,2048,3072,4096").split(",")]
+
+    device_kind = jax.devices()[0].device_kind
+    rows = []
+    for stage in stages:
+        for accum in accums:
+            for remat in remats:
+                # feasibility is monotone in width, so walk the ladder in
+                # order and keep the last width whose compiled program
+                # fits — the cheap small-model probes come first, and the
+                # expensive near-boundary ones are the same compiles a
+                # bisection would pay for anyway
+                feasible = None  # (ladder idx, n_params, peak)
+                for i in range(len(ladder)):
+                    try:
+                        n, peak = _memory_probe(
+                            stage, accum, remat, ladder[i], vocab, batch,
+                            seq, uf,
+                        )
+                    except Exception as e:
+                        sys.stderr.write(
+                            f"bench memory: probe embed={ladder[i]} "
+                            f"zero{stage}/{accum}/{remat} failed: {e!r}\n"
+                        )
+                        break
+                    if peak > budget:
+                        break
+                    feasible = (i, n, peak)
+                if feasible is None:
+                    sys.stderr.write(
+                        f"bench memory: zero{stage}/{accum}/{remat}: even "
+                        f"embed={ladder[0]} exceeds the budget\n"
+                    )
+                    continue
+                _, n_params, peak = feasible
+                row = {
+                    "metric": (
+                        f"max_params_per_chip_zero{stage}_{accum}_"
+                        f"remat-{remat}"
+                    ),
+                    "value": n_params,
+                    "unit": "params",
+                    "vs_baseline": None,
+                    "zero_stage": stage,
+                    "grad_accum": accum,
+                    "remat_policy": remat,
+                    "embed_dim": ladder[feasible[0]],
+                    "peak_bytes": peak,
+                    "budget_bytes": int(budget),
+                    "batch_size": batch,
+                    "seq_len": seq,
+                    "update_freq": uf,
+                    "n_chips": jax.device_count(),
+                    "device_kind": device_kind,
+                }
+                _append_partial(row)
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+    if not rows:
+        raise RuntimeError("memory sweep produced no feasible rows")
+    return rows[-1]
+
+
 def main():
     _backend_watchdog()
     if os.environ.get("BENCH_PIPELINE", "") not in ("", "0", "false"):
@@ -926,6 +1092,8 @@ def main():
                 runner = run_serve_bench
             elif c == "kernels":
                 runner = run_kernel_bench
+            elif c == "memory":
+                runner = run_memory_bench
             else:
                 runner = lambda c=c: run_config(c)
             print(json.dumps(runner()), flush=True)

@@ -1746,6 +1746,72 @@ def test_sharding_legality_shard_map_arity(tmp_path):
     assert "1 spec(s)" in vs[0].message and "2 positional" in vs[0].message
 
 
+def test_sharding_legality_zero_buffer_axis(tmp_path):
+    """Flat optimizer buffers (optim/ modules) shard over 'data' only:
+    a PartitionSpec naming a model-parallel axis there is flagged."""
+    import textwrap
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    optim = tmp_path / "optim"
+    optim.mkdir()
+    (optim / "flat.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..mesh import DATA_AXIS, MODEL_AXIS
+
+            def shard_flat(bufs, mesh):
+                bad = NamedSharding(mesh, P(MODEL_AXIS))
+                return [
+                    jax.lax.with_sharding_constraint(b, bad) for b in bufs
+                ]
+            """
+        )
+    )
+    vs = _lint_dir(tmp_path, select=["sharding-legality"])
+    assert rule_names(vs) == ["sharding-legality"]
+    assert "flat optimizer buffer" in vs[0].message
+    assert "'model'" in vs[0].message
+
+
+def test_sharding_legality_zero_buffer_data_axis_ok(tmp_path):
+    """The sanctioned P('data') flat-buffer sharding passes, and the same
+    model-parallel spec OUTSIDE optim/ stays legal (it's how params
+    shard)."""
+    import textwrap
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    optim = tmp_path / "optim"
+    optim.mkdir()
+    code = textwrap.dedent(
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..mesh import DATA_AXIS, MODEL_AXIS
+
+        def shard_flat(bufs, mesh):
+            good = NamedSharding(mesh, P(DATA_AXIS))
+            return [
+                jax.lax.with_sharding_constraint(b, good) for b in bufs
+            ]
+        """
+    )
+    (optim / "flat.py").write_text(code)
+    (tmp_path / "layers.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+            from .mesh import MODEL_AXIS
+
+            TP_RULE = P(None, MODEL_AXIS)
+            """
+        )
+    )
+    vs = _lint_dir(tmp_path, select=["sharding-legality"])
+    assert vs == []
+
+
 def test_sharding_legality_negatives(tmp_path):
     """Clean declared-axis usage, unresolvable axis expressions, and a
     lint set WITHOUT mesh.py (nothing to check against) all pass."""

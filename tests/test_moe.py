@@ -96,7 +96,7 @@ def test_capacity_drops_overflow_tokens():
     assert zero_rows >= S - 2 * 8  # at most cap tokens per expert survive
 
 
-def _mk_trainer(data, expert):
+def _mk_trainer(data, expert, deterministic=False):
     from unicore_tpu.losses import LOSS_REGISTRY
     from unicore_tpu.models.bert import BertModel
     from unicore_tpu.tasks.unicore_task import UnicoreTask
@@ -127,6 +127,7 @@ def _mk_trainer(data, expert):
         encoder_ffn_embed_dim=64, encoder_attention_heads=4, max_seq_len=32,
         post_ln=True, dropout=0.0, emb_dropout=0.0, attention_dropout=0.0,
         moe_experts=4, moe_every=2, moe_top_k=2,
+        moe_deterministic=deterministic,
     )
     loss = LOSS_REGISTRY["masked_lm_moe"](_T(args))
     return Trainer(args, _T(args), model, loss)
@@ -141,12 +142,22 @@ def _sample(seed=0, rows=8):
 
 def test_expert_parallel_matches_pure_dp():
     """A dp=4 x ep=2 mesh must produce the same training trajectory as
-    dp=8 (pure data parallel): expert sharding is a layout change only."""
+    dp=8 (pure data parallel): expert sharding is a layout change only.
+
+    Runs under --moe-deterministic-reduction: the expert combine executes
+    as a fully-replicated shard_map manual region, so none of its f32
+    reductions (router contraction, dispatch scatter, expert FFN and the
+    weight-gradient contractions in their transposes) is partitioned by a
+    mesh axis whose rank count would change the summation tree.  Without
+    the option the dp=8 vs dp=4 x ep=2 trajectories drift at ~1e-3 after
+    two Adam steps (ulp-level reduction reassociation amplified through
+    Adam's eps on near-zero gradients — the old standing tier-1 failure,
+    ROADMAP item 1)."""
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
     results = []
     for data, expert in ((8, 1), (4, 2)):
-        tr = _mk_trainer(data, expert)
+        tr = _mk_trainer(data, expert, deterministic=True)
         tr.train_step([_sample(0)])
         tr.train_step([_sample(1)])
         macc = {k: float(v) for k, v in jax.device_get(tr._macc).items()}

@@ -61,7 +61,9 @@ def test_resource_exhausted_is_enriched():
     msg = str(ei.value)
     assert "mesh" in msg
     assert "(8, 16)" in msg  # the batch geometry
-    assert "--update-freq" in msg and "--activation-checkpoint" in msg
+    # the remedies name the memory-headroom tier's flags
+    assert "--update-freq" in msg and "--remat-policy" in msg
+    assert "--zero-stage" in msg and "--grad-accum adama" in msg
     assert "RESOURCE_EXHAUSTED" in msg
 
 

@@ -14,7 +14,15 @@ walk the optimized HLO, and report
 - the **top-N unfused elementwise chains**: connected groups of elementwise
   ops still sitting at computation level, i.e. fusion opportunities XLA
   declined — the first place to look when a "fused" change didn't shrink
-  the program.
+  the program,
+- a **peak-memory section** (``memory``): the compiler's own per-device
+  allocation stats — argument / output / temp / aliased bytes plus
+  ``peak_bytes`` (argument + output + temp − alias, the static upper bound
+  XLA budgets for one execution).  This is the device-free number the
+  memory-headroom tier regression-checks: ZeRO-2/3 + AdamA accumulation
+  must shrink ``temp_bytes``/``peak_bytes`` of the grad-accum scan program
+  vs the zero1+buffer baseline (tests/test_memory_headroom.py,
+  docs/performance.md "Memory headroom").
 
 The parser is text-based (``compiled.as_text()``) and intentionally
 tolerant: unknown shapes/opcodes degrade to zero-byte entries, never a
@@ -199,10 +207,22 @@ def audit_compiled(compiled, top_n: int = 5) -> Optional[Dict]:
     report = audit_hlo(hlo, top_n=top_n)
     try:
         mem = compiled.memory_analysis()
+        arg_b = int(mem.argument_size_in_bytes)
+        out_b = int(mem.output_size_in_bytes)
+        tmp_b = int(mem.temp_size_in_bytes)
+        alias_b = int(getattr(mem, "alias_size_in_bytes", 0) or 0)
         report["memory"] = {
-            "argument_bytes": int(mem.argument_size_in_bytes),
-            "output_bytes": int(mem.output_size_in_bytes),
-            "temp_bytes": int(mem.temp_size_in_bytes),
+            "argument_bytes": arg_b,
+            "output_bytes": out_b,
+            "temp_bytes": tmp_b,
+            "alias_bytes": alias_b,
+            "generated_code_bytes": int(
+                getattr(mem, "generated_code_size_in_bytes", 0) or 0
+            ),
+            # the static per-device upper bound XLA budgets for one
+            # execution (aliased output bytes overlap arguments, so they
+            # subtract out)
+            "peak_bytes": arg_b + out_b + tmp_b - alias_b,
         }
     except Exception:
         pass

@@ -22,7 +22,14 @@ axis-name argument) and checks every sharding call site in the lint set:
 * **rank-mismatch** — a ``shard_map`` call whose literal ``in_specs``
   tuple length differs from the wrapped local function's positional
   signature (specs and arguments pair positionally; a mismatch is a
-  guaranteed tree-structure error at trace time).
+  guaranteed tree-structure error at trace time);
+* **zero-buffer-axis** — inside ``optim/`` modules (the flat-optimizer-
+  buffer domain), a ``PartitionSpec`` naming a declared mesh axis OTHER
+  than the data axis: ZeRO stages shard the flat grad/moment/master
+  buffers over ``'data'`` only — a model/seq/pipe/expert axis there would
+  misalign each rank's FlatPlan segment with the dp reduce-scatter and
+  silently replicate (or worse, shear) the optimizer math
+  (docs/lint.md, "sharding-legality").
 
 Axis names that cannot be resolved statically (parameters, computed
 strings) are skipped — zero-noise bias, same trade as every other rule.
@@ -179,14 +186,23 @@ class ShardingLegality(LintRule):
         mesh_module, constants, declared = _mesh_declaration(modules)
         if mesh_module is None or not declared:
             return
+        # the data axis name for the zero-buffer-axis check (DATA_AXIS
+        # constant, else the literal 'data' when declared)
+        data_axis = constants.get(
+            "DATA_AXIS", "data" if "data" in declared else None
+        )
         for module in modules:
             env = _ModuleEnv(module, constants)
+            in_optim = "optim" in os.path.normpath(module.path).split(os.sep)
             for node in ast.walk(module.tree):
                 if not isinstance(node, ast.Call):
                     continue
                 name = terminal_name(node.func)
                 if name in env.pspec_names or name == "PartitionSpec":
-                    yield from self._check_pspec(module, env, declared, node)
+                    yield from self._check_pspec(
+                        module, env, declared, node,
+                        zero_data_axis=data_axis if in_optim else None,
+                    )
                 elif name in _AXIS_CALLS or name in _AXIS_KWARG_CALLS:
                     yield from self._check_axis_call(
                         module, env, declared, node, name
@@ -198,7 +214,9 @@ class ShardingLegality(LintRule):
 
     # -- PartitionSpec(...) ------------------------------------------------
 
-    def _check_pspec(self, module, env, declared, call) -> Iterator[Violation]:
+    def _check_pspec(self, module, env, declared, call,
+                     zero_data_axis: Optional[str] = None
+                     ) -> Iterator[Violation]:
         seen: Dict[str, ast.AST] = {}
         for arg in call.args:
             entries = (
@@ -226,6 +244,18 @@ class ShardingLegality(LintRule):
                         f"PartitionSpec reuses axis '{axis}' for a second "
                         "dimension: one mesh axis can shard at most one "
                         "dimension of an array",
+                    )
+                elif zero_data_axis is not None and axis != zero_data_axis:
+                    yield self._v(
+                        module,
+                        el,
+                        f"optim/ PartitionSpec shards a flat optimizer "
+                        f"buffer on axis '{axis}', which the mesh declares "
+                        "for model parallelism — ZeRO stages shard "
+                        f"optimizer state over '{zero_data_axis}' only; "
+                        "any other axis misaligns each rank's FlatPlan "
+                        "segment with the dp reduce-scatter "
+                        "(docs/lint.md, 'sharding-legality')",
                     )
                 seen.setdefault(axis, el)
 

@@ -26,6 +26,7 @@ from unicore_tpu.models.unicore_model import (
     strip_diagnostic_collections,
 )
 from unicore_tpu.modules import LayerNorm, TransformerEncoder, bert_init
+from unicore_tpu.modules.remat import resolve_remat_policy as _resolve_remat
 
 
 class BertLMHead(nn.Module):
@@ -95,13 +96,19 @@ class BertModel(BaseUnicoreModel):
     activation_fn: str = "gelu"
     pooler_activation_fn: str = "tanh"
     post_ln: bool = True
-    remat: bool = False  # activation checkpointing (--activation-checkpoint)
+    remat: bool = False  # deprecated boolean (--activation-checkpoint)
+    # activation-remat policy (--remat-policy, modules/remat.py):
+    # 'none'/'all'/'dots'/'save-anything-pjit'; '' defers to the boolean
+    remat_policy: str = ""
     num_classes: int = -1  # >0 adds a classification head
     # mixture-of-experts FFN (expert parallelism over the mesh 'expert'
     # axis, modules/moe.py); 0 = dense FFN everywhere
     moe_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 2
+    # fixed f32 reduction order for the expert combine
+    # (--moe-deterministic-reduction; modules/moe.py)
+    moe_deterministic: bool = False
     # GPipe pipeline parallelism over the mesh 'pipe' axis
     # (parallel/pipeline.py); 0 = off.  Set from --pipeline-parallel-size.
     pipeline_stages: int = 0
@@ -142,8 +149,10 @@ class BertModel(BaseUnicoreModel):
         parser.add_argument("--post-ln", type=utils.str_to_bool,
                             help="use post layernorm or pre layernorm")
         parser.add_argument("--activation-checkpoint", action="store_true",
-                            help="rematerialize encoder layers in the backward "
-                                 "pass (trade FLOPs for activation memory)")
+                            help="DEPRECATED: same as --remat-policy all "
+                                 "(rematerialize encoder layers in the "
+                                 "backward pass; --remat-policy also offers "
+                                 "'dots' and 'save-anything-pjit')")
         parser.add_argument("--moe-experts", type=int,
                             help="number of routed FFN experts (0 = dense); "
                                  "shards over the mesh 'expert' axis")
@@ -152,6 +161,18 @@ class BertModel(BaseUnicoreModel):
                                  "--moe-experts > 0")
         parser.add_argument("--moe-top-k", type=int,
                             help="experts per token")
+        parser.add_argument("--moe-deterministic-reduction",
+                            action="store_true",
+                            help="fix the f32 reduction order of the expert "
+                                 "combine by replicating the token stream "
+                                 "through the MoE block: the training "
+                                 "trajectory becomes independent of the "
+                                 "dp/ep mesh split (dp=8 == dp=4 x ep=2) at "
+                                 "the cost of redundant replicated FFN "
+                                 "compute; also disables MoE router jitter "
+                                 "and expert activation dropout, which are "
+                                 "inherently order-sensitive "
+                                 "(docs/PARALLELISM.md)")
         parser.add_argument("--pipeline-microbatches", type=int,
                             help="GPipe microbatches per update when "
                                  "--pipeline-parallel-size > 1 (batch must "
@@ -178,10 +199,14 @@ class BertModel(BaseUnicoreModel):
             pooler_activation_fn=args.pooler_activation_fn,
             post_ln=args.post_ln,
             remat=getattr(args, "activation_checkpoint", False),
+            remat_policy=_resolve_remat(args),
             num_classes=getattr(args, "num_classes", -1),
             moe_experts=getattr(args, "moe_experts", 0) or 0,
             moe_every=getattr(args, "moe_every", 2) or 2,
             moe_top_k=getattr(args, "moe_top_k", 2) or 2,
+            moe_deterministic=getattr(
+                args, "moe_deterministic_reduction", False
+            ),
             pipeline_stages=(
                 pp if (pp := getattr(args, "pipeline_parallel_size", 1)) > 1
                 else 0
@@ -222,9 +247,11 @@ class BertModel(BaseUnicoreModel):
             max_rel_pos=128,
             post_ln=self.post_ln,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             moe_experts=self.moe_experts,
             moe_every=self.moe_every,
             moe_top_k=self.moe_top_k,
+            moe_deterministic=self.moe_deterministic,
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
             use_ring=self.use_ring,

@@ -17,6 +17,7 @@ from unicore_tpu.models.unicore_model import (
     strip_diagnostic_collections,
 )
 from unicore_tpu.modules import EvoformerStack, LayerNorm, bert_init
+from unicore_tpu.modules.remat import resolve_remat_policy as _resolve_remat
 from unicore_tpu.modules.transformer_encoder import make_rp_bucket
 
 
@@ -33,6 +34,8 @@ class EvoformerModel(BaseUnicoreModel):
     max_seq_len: int = 256
     rel_pos_bins: int = 32
     remat: bool = False
+    # activation-remat policy (--remat-policy, modules/remat.py)
+    remat_policy: str = ""
     # GPipe over the mesh 'pipe' axis (the 48-block stack is the natural
     # pipeline candidate); set from --pipeline-parallel-size
     pipeline_stages: int = 0
@@ -51,7 +54,8 @@ class EvoformerModel(BaseUnicoreModel):
         parser.add_argument("--pair-heads", type=int)
         parser.add_argument("--dropout", type=float)
         parser.add_argument("--max-seq-len", type=int)
-        parser.add_argument("--activation-checkpoint", action="store_true")
+        parser.add_argument("--activation-checkpoint", action="store_true",
+                            help="DEPRECATED: same as --remat-policy all")
         parser.add_argument("--pipeline-microbatches", type=int,
                             help="GPipe microbatches per update when "
                                  "--pipeline-parallel-size > 1")
@@ -70,6 +74,7 @@ class EvoformerModel(BaseUnicoreModel):
             dropout=args.dropout,
             max_seq_len=args.max_seq_len,
             remat=getattr(args, "activation_checkpoint", False),
+            remat_policy=_resolve_remat(args),
             pipeline_stages=(
                 pp if (pp := getattr(args, "pipeline_parallel_size", 1)) > 1
                 else 0
@@ -112,6 +117,7 @@ class EvoformerModel(BaseUnicoreModel):
             pair_heads=self.pair_heads,
             dropout=self.dropout,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             pipeline_stages=self.pipeline_stages,
             pipeline_microbatches=self.pipeline_microbatches,
             seq_shard=self.seq_shard,

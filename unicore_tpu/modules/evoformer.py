@@ -613,6 +613,9 @@ class EvoformerStack(nn.Module):
     pair_heads: int = 4
     dropout: float = 0.1
     remat: bool = True
+    # activation-remat policy name (modules/remat.py): 'none', 'all',
+    # 'dots', 'save-anything-pjit'; empty string defers to the boolean
+    remat_policy: str = ""
     # GPipe pipeline parallelism over the mesh 'pipe' axis
     # (parallel/pipeline.py).  The 48-block stack is the model where PP
     # earns its keep: each pipe rank holds num_blocks/P blocks' params and
@@ -651,12 +654,14 @@ class EvoformerStack(nn.Module):
             )
         shard_rows = seq_row_constrainer(L, self.seq_shard, "evoformer")
         seq_on = shard_rows.engaged
-        block_cls = EvoformerIteration
-        if self.remat:
-            # trade FLOPs for activation memory across the deep stack
-            block_cls = nn.remat(
-                EvoformerIteration, static_argnums=(5,)
-            )
+        from .remat import remat_wrap
+
+        # trade FLOPs for activation memory across the deep stack
+        block_cls = remat_wrap(
+            EvoformerIteration,
+            self.remat_policy or ("all" if self.remat else "none"),
+            static_argnums=(5,),
+        )
         msa, pair = shard_rows(msa, 2), shard_rows(pair, 1)
         for i in range(self.num_blocks):
             msa, pair = block_cls(
@@ -755,9 +760,15 @@ class EvoformerStack(nn.Module):
                 if step_rng is not None:
                     rngs = {"dropout": jax.random.fold_in(step_rng, li)}
                 apply = template.apply
-                if self.remat:
+                _policy = self.remat_policy or (
+                    "all" if self.remat else "none"
+                )
+                if _policy != "none":
+                    from .remat import policy_fn
+
                     apply = jax.checkpoint(
-                        template.apply, static_argnums=(5,)
+                        template.apply, static_argnums=(5,),
+                        policy=policy_fn(_policy),
                     )
                 m_, z_ = apply(
                     {"params": p_block}, m_, z_, mm, pm, train, rngs=rngs

@@ -34,6 +34,28 @@ from .multihead_attention import SelfMultiheadAttention
 _router_init = nn.initializers.normal(0.02)
 
 
+class _Router(nn.Module):
+    """Router parameter holder: creates ``{kernel, bias}`` under the same
+    ``router`` scope (and with the same init) as the ``nn.Dense`` it
+    replaces, but RETURNS the arrays instead of applying them — the
+    matmul itself runs in the (possibly shard_map'd) pure core, so the
+    deterministic-reduction mode covers the router contraction too."""
+
+    num_experts: int
+    embed_dim: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param(
+            "kernel", _router_init, (self.embed_dim, self.num_experts),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.num_experts,), jnp.float32
+        )
+        return kernel, bias
+
+
 class MoELayer(nn.Module):
     """Top-k routed expert FFN (drop-in for the dense fc1/act/fc2 block)."""
 
@@ -53,27 +75,106 @@ class MoELayer(nn.Module):
     # N=32k, E=64); kept as the readable reference semantics and pinned to
     # the scatter path by an equivalence test (tests/test_moe.py).
     dispatch: str = "scatter"
+    # Fixed f32 reduction order for the expert combine
+    # (--moe-deterministic-reduction): the token stream is pinned
+    # REPLICATED before routing, so every rank computes the full combine
+    # in the same local order — router/expert weight-gradient contractions
+    # over the token dim stop being partitioned by the data axis, whose
+    # rank count otherwise changes the f32 summation tree (the known
+    # dp=8 vs dp=4 x ep=2 trajectory drift, ROADMAP item 1).  Costs the
+    # redundant replicated compute of one FFN block per token; off by
+    # default (docs/PARALLELISM.md).
+    deterministic_reduction: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         E, D, F = self.num_experts, self.embed_dim, self.ffn_embed_dim
         B, S, _ = x.shape
         N = B * S
+        cap = max(8, int(self.capacity_factor * self.top_k * N / E))
         tokens = x.reshape(N, D)
+
+        rk, rb = _Router(E, D, name="router")()
+        # --- expert weights: (E, ...) shard over the 'expert' mesh axis
+        w1 = self.param("experts_fc1", _router_init, (E, D, F), jnp.float32)
+        b1 = self.param("experts_bias1", nn.initializers.zeros, (E, F),
+                        jnp.float32)
+        w2 = self.param("experts_fc2", _router_init, (E, F, D), jnp.float32)
+        b2 = self.param("experts_bias2", nn.initializers.zeros, (E, D),
+                        jnp.float32)
+
+        if self.deterministic_reduction:
+            # dropout/jitter stay OFF in deterministic-reduction mode (the
+            # parity contract is an eval/no-dropout property, and the
+            # replicated manual region takes only the seven array inputs)
+            core = self._moe_core
+            from unicore_tpu.parallel.compat import shard_map
+            from unicore_tpu.parallel.mesh import get_global_mesh
+
+            mesh = get_global_mesh()
+            if mesh is not None and len(mesh.devices.flat) > 1:
+                from jax.sharding import PartitionSpec as P
+
+                # full-manual region with EVERYTHING replicated: each rank
+                # computes the complete combine locally in one fixed order,
+                # so no piece of the expert math (router contraction,
+                # dispatch scatter, expert FFN, weight-gradient reductions
+                # in the transpose) is ever partitioned by a mesh axis —
+                # dp=8 and dp=4 x ep=2 run the identical local program
+                core = shard_map(
+                    core, mesh=mesh,
+                    in_specs=(P(),) * 7,
+                    out_specs=(P(), P(), P()),
+                    check_vma=True,
+                )
+            out, aux, overflow = core(tokens, rk, rb, w1, b1, w2, b2)
+        else:
+            # RNG-dependent arrays sample OUTSIDE the core so the core
+            # stays a pure function of arrays
+            jitter = None
+            if train and self.router_jitter > 0.0:
+                jitter = jax.random.uniform(
+                    self.make_rng("dropout"), (N, D),
+                    minval=1.0 - self.router_jitter,
+                    maxval=1.0 + self.router_jitter,
+                )
+            drop_keep = None
+            if train and self.activation_dropout > 0.0:
+                drop_keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - self.activation_dropout,
+                    (E, cap, F),
+                )
+            out, aux, overflow = self._moe_core(
+                tokens, rk, rb, w1, b1, w2, b2,
+                jitter=jitter, drop_keep=drop_keep,
+            )
+        self.sow("losses", "moe_aux", aux)
+        # router health: fraction of routes dropped by the capacity bound —
+        # without this, capacity starvation is invisible in the logs.  Sown
+        # to 'metrics' (not 'losses') so the aux-loss sum never includes it.
+        self.sow("metrics", "moe_overflow", overflow)
+        return out.reshape(B, S, D)
+
+    def _moe_core(self, tokens, rk, rb, w1, b1, w2, b2, jitter=None,
+                  drop_keep=None):
+        """Pure expert-combine core: route, capacity-bound, dispatch, FFN,
+        combine.  Returns ``(out (N, D), aux_loss, overflow_frac)``.  No
+        flax scope access — in deterministic-reduction mode this body runs
+        inside a fully-replicated shard_map manual region (rng-dependent
+        masks are sampled by the caller; the replicated region can't carry
+        them through ``in_specs``, and jitter/dropout randomness composes
+        with per-rank decorrelation anyway, so deterministic mode runs
+        them off — the parity contract is an eval/no-dropout property)."""
+        E, D, F = self.num_experts, self.embed_dim, self.ffn_embed_dim
+        N = tokens.shape[0]
+        cap = max(8, int(self.capacity_factor * self.top_k * N / E))
+        dtype = tokens.dtype
 
         # --- routing (fp32: small, and router logits are precision-critical)
         r_in = tokens.astype(jnp.float32)
-        if train and self.router_jitter > 0.0:
-            noise = jax.random.uniform(
-                self.make_rng("dropout"), r_in.shape,
-                minval=1.0 - self.router_jitter,
-                maxval=1.0 + self.router_jitter,
-            )
-            r_in = r_in * noise
-        logits = nn.Dense(
-            E, name="router", kernel_init=_router_init,
-            dtype=jnp.float32, param_dtype=jnp.float32,
-        )(r_in)
+        if jitter is not None:
+            r_in = r_in * jitter
+        logits = r_in @ rk + rb
         probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
         gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)  # (N, k)
         # renormalize the selected gates so they sum to 1 per token
@@ -89,10 +190,8 @@ class MoELayer(nn.Module):
         load = sel.mean(0) / self.top_k  # fraction of routes landing on e
         importance = probs.mean(0)       # mean router probability of e
         aux = E * jnp.sum(load * importance)
-        self.sow("losses", "moe_aux", aux)
 
         # --- capacity-bounded routing positions
-        cap = max(8, int(self.capacity_factor * self.top_k * N / E))
         # position of each (token, choice) within its expert's queue:
         # flatten choices in priority order (all top-1 first) so second
         # choices drop before first choices when an expert overflows
@@ -103,26 +202,15 @@ class MoELayer(nn.Module):
         pos = jnp.sum(pos * onehot, axis=-1)         # (kN,)
         keep = pos < cap
         flat_gate = jnp.where(keep, flat_gate, 0.0)
-        # router health: fraction of routes dropped by the capacity bound —
-        # without this, capacity starvation is invisible in the logs.  Sown
-        # to 'metrics' (not 'losses') so the aux-loss sum never includes it.
-        self.sow("metrics", "moe_overflow",
-                 1.0 - keep.astype(jnp.float32).mean())
+        overflow = 1.0 - keep.astype(jnp.float32).mean()
 
-        # --- expert weights: (E, ...) shard over the 'expert' mesh axis
-        w1 = self.param("experts_fc1", _router_init, (E, D, F), jnp.float32)
-        b1 = self.param("experts_bias1", nn.initializers.zeros, (E, F),
-                        jnp.float32)
-        w2 = self.param("experts_fc2", _router_init, (E, F, D), jnp.float32)
-        b2 = self.param("experts_bias2", nn.initializers.zeros, (E, D),
-                        jnp.float32)
         act = utils.get_activation_fn(self.activation_fn)
 
         if self.dispatch == "dense":
             # reference semantics: (kN, E, cap) one-hot masks + einsums
             pos_oh = jax.nn.one_hot(jnp.where(keep, pos, cap), cap + 1,
-                                    dtype=x.dtype)[..., :cap]  # (kN, cap)
-            disp = onehot.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
+                                    dtype=dtype)[..., :cap]  # (kN, cap)
+            disp = onehot.astype(dtype)[:, :, None] * pos_oh[:, None, :]
             comb = disp.astype(jnp.float32) * flat_gate[:, None, None]
             disp = disp.reshape(self.top_k, N, E, cap).sum(0)
             comb = comb.reshape(self.top_k, N, E, cap).sum(0)
@@ -134,21 +222,23 @@ class MoELayer(nn.Module):
             slot = jnp.where(keep, flat_idx * cap + pos, E * cap)  # (kN,)
             tokens_rep = jnp.tile(tokens, (self.top_k, 1))  # choice-major
             expert_in = (
-                jnp.zeros((E * cap + 1, D), x.dtype)
-                .at[slot].add(tokens_rep.astype(x.dtype))
+                jnp.zeros((E * cap + 1, D), dtype)
+                .at[slot].add(tokens_rep.astype(dtype))
             )[:-1].reshape(E, cap, D)
 
-        h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(dtype))
         h = act(h + b1[:, None].astype(h.dtype))
-        if train and self.activation_dropout > 0.0:
-            h = nn.Dropout(rate=self.activation_dropout)(
-                h, deterministic=False
+        if drop_keep is not None:
+            # nn.Dropout semantics on a caller-sampled keep mask
+            h = jnp.where(
+                drop_keep, h / (1.0 - self.activation_dropout),
+                jnp.zeros((), h.dtype),
             )
-        out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
+        out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype))
         out_e = out_e + b2[:, None].astype(out_e.dtype)
 
         if self.dispatch == "dense":
-            out = jnp.einsum("nec,ecd->nd", comb.astype(x.dtype), out_e)
+            out = jnp.einsum("nec,ecd->nd", comb.astype(dtype), out_e)
         else:
             out_flat = jnp.concatenate(
                 [out_e.reshape(E * cap, D),
@@ -156,7 +246,7 @@ class MoELayer(nn.Module):
             )
             gathered = out_flat[slot] * flat_gate[:, None].astype(out_e.dtype)
             out = gathered.reshape(self.top_k, N, D).sum(0)
-        return out.reshape(B, S, D)
+        return out, aux, overflow
 
 
 class MoEEncoderLayer(nn.Module):
@@ -177,6 +267,7 @@ class MoEEncoderLayer(nn.Module):
     top_k: int = 2
     capacity_factor: float = 1.25
     dispatch: str = "scatter"
+    deterministic_reduction: bool = False
 
     @nn.compact
     def __call__(
@@ -227,6 +318,7 @@ class MoEEncoderLayer(nn.Module):
             top_k=self.top_k,
             capacity_factor=self.capacity_factor,
             dispatch=self.dispatch,
+            deterministic_reduction=self.deterministic_reduction,
             activation_fn=self.activation_fn,
             activation_dropout=self.activation_dropout,
             name="moe",

@@ -1,0 +1,93 @@
+"""Configurable activation-rematerialization policies (``--remat-policy``).
+
+The boolean ``--activation-checkpoint`` (recompute EVERYTHING in the
+backward pass) is one point on a spectrum ``jax.checkpoint_policies``
+exposes; the deep stacks here (bert encoder, evoformer blocks, pipelined
+stages) thread a POLICY NAME instead, so the FLOPs/memory trade is a
+config choice, not a rewrite:
+
+====================  =====================================================
+``none``              no remat: every activation saved (fastest backward,
+                      peak activation memory O(layers))
+``all``               ``nothing_saveable``: recompute everything — the old
+                      ``--activation-checkpoint`` (max memory headroom,
+                      ~1/3 extra FLOPs)
+``dots``              ``dots_saveable``: save matmul/einsum outputs,
+                      recompute elementwise chains — recompute is VPU-cheap,
+                      the MXU work is not (the usual sweet spot on TPU)
+``save-anything-pjit``  ``save_anything_except_these_names()`` with no
+                      names: everything saveable is saved, but the
+                      ``jax.checkpoint`` region boundary is kept — a
+                      no-recompute baseline whose value is the structural
+                      boundary GSPMD/pjit can schedule collectives around
+                      (A/B anchor for the policies above)
+====================  =====================================================
+
+``resolve_remat_policy(args)`` maps the CLI surface (``--remat-policy``
+plus the deprecated boolean ``--activation-checkpoint``, warn-once) to one
+of these names; model ``build_model`` hooks pass the name down and the
+stacks wrap their layer class via :func:`remat_wrap`.
+"""
+
+import logging
+
+import flax.linen as nn
+import jax
+
+logger = logging.getLogger(__name__)
+
+REMAT_POLICIES = ("none", "all", "dots", "save-anything-pjit")
+
+_deprecation_warned = False
+
+
+def policy_fn(name: str):
+    """The ``jax.checkpoint`` policy callable for a policy name (``None``
+    for 'all' — jax's default is nothing_saveable; must not be called for
+    'none', which means no remat at all)."""
+    if name == "all":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "save-anything-pjit":
+        return jax.checkpoint_policies.save_anything_except_these_names()
+    raise ValueError(
+        f"unknown remat policy {name!r} (choices: {', '.join(REMAT_POLICIES)})"
+    )
+
+
+def resolve_remat_policy(args) -> str:
+    """Policy name from the flags.  ``--remat-policy`` wins; unset, the
+    deprecated boolean ``--activation-checkpoint`` maps to 'all' with a
+    one-shot deprecation warning; neither means 'none'."""
+    global _deprecation_warned
+    policy = getattr(args, "remat_policy", None)
+    legacy = bool(getattr(args, "activation_checkpoint", False))
+    if policy is not None:
+        if policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"--remat-policy {policy!r}: choices are "
+                f"{', '.join(REMAT_POLICIES)}"
+            )
+        return policy
+    if legacy:
+        if not _deprecation_warned:
+            _deprecation_warned = True
+            logger.warning(
+                "--activation-checkpoint is deprecated; use --remat-policy "
+                "all (or 'dots' to keep matmul outputs — "
+                "docs/performance.md, 'Memory headroom')"
+            )
+        return "all"
+    return "none"
+
+
+def remat_wrap(layer_cls, policy_name: str, static_argnums=()):
+    """``nn.remat`` the flax layer class under ``policy_name`` ('none'
+    returns the class unwrapped)."""
+    if not policy_name or policy_name == "none":
+        return layer_cls
+    return nn.remat(
+        layer_cls, static_argnums=static_argnums,
+        policy=policy_fn(policy_name),
+    )

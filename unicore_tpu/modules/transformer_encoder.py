@@ -185,8 +185,11 @@ class TransformerEncoder(nn.Module):
     rel_pos_bins: int = 32
     max_rel_pos: int = 128
     post_ln: bool = False
-    remat: bool = False  # activation checkpointing per layer
+    remat: bool = False  # deprecated boolean: remat_policy 'all' when set
                          # (reference utils.checkpoint_sequential, utils.py:306-333)
+    # activation-remat policy name (modules/remat.py): 'none', 'all',
+    # 'dots', 'save-anything-pjit'; empty string defers to the boolean
+    remat_policy: str = ""
     use_ring: bool = False  # seq parallelism (mesh 'seq' axis)
     seq_impl: str = "ring"  # 'ring' or 'ulysses' (--seq-parallel-impl)
     # mixture-of-experts FFN (expert parallelism, modules/moe.py): every
@@ -195,6 +198,9 @@ class TransformerEncoder(nn.Module):
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # fixed f32 reduction order for the expert combine (modules/moe.py:
+    # MoELayer.deterministic_reduction) — --moe-deterministic-reduction
+    moe_deterministic: bool = False
     # pipeline parallelism (parallel/pipeline.py): layers stacked on a
     # leading axis sharded over the mesh 'pipe' axis, GPipe microbatch
     # schedule.  0 = off.  Requires encoder_layers % pipe == 0 and
@@ -213,13 +219,13 @@ class TransformerEncoder(nn.Module):
             from .moe import MoEEncoderLayer
 
             moe_cls = MoEEncoderLayer
-        if self.remat:
-            # static argnums (incl. self at 0): return_attn=4, train=5
-            layer_cls = nn.remat(
-                TransformerEncoderLayer, static_argnums=(4, 5)
-            )
-            if moe_cls is not None:
-                moe_cls = nn.remat(moe_cls, static_argnums=(4, 5))
+        from .remat import remat_wrap
+
+        policy = self.remat_policy or ("all" if self.remat else "none")
+        # static argnums (incl. self at 0): return_attn=4, train=5
+        layer_cls = remat_wrap(layer_cls, policy, static_argnums=(4, 5))
+        if moe_cls is not None:
+            moe_cls = remat_wrap(moe_cls, policy, static_argnums=(4, 5))
 
         def build_layer(i):
             common = dict(
@@ -242,6 +248,7 @@ class TransformerEncoder(nn.Module):
                     num_experts=self.moe_experts,
                     top_k=self.moe_top_k,
                     capacity_factor=self.moe_capacity_factor,
+                    deterministic_reduction=self.moe_deterministic,
                     **common,
                 )
             return layer_cls(**common)

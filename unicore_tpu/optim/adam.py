@@ -63,6 +63,12 @@ class Adam(UnicoreOptimizer):
         return bool(getattr(self.args, "fused_adam", False))
 
     @property
+    def zero_stage(self):
+        from unicore_tpu.parallel.sharding import resolve_zero_stage
+
+        return resolve_zero_stage(self.args)
+
+    @property
     def betas(self):
         b = getattr(self.args, "adam_betas", "(0.9, 0.999)")
         if isinstance(b, str):
@@ -111,6 +117,7 @@ class Adam(UnicoreOptimizer):
             return multi_tensor.fused_adam_update(
                 grads32, slots, master, lr, step, decay_mask,
                 beta1=beta1, beta2=beta2, eps=eps, weight_decay=wd,
+                zero_stage=self.zero_stage,
             )
         stepf = step.astype(jnp.float32)
         bc1 = 1.0 - beta1 ** stepf
@@ -142,3 +149,113 @@ class Adam(UnicoreOptimizer):
             new_v.append(vv)
         unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return unf(new_p), {"m": unf(new_m), "v": unf(new_v)}
+
+    # ------------------------------------------------------------------
+    # AdamA accumulation (--grad-accum adama, arXiv 2305.19982): the scan
+    # carries moment ACCUMULATORS instead of a full fp32 gradient pytree.
+    # Contract (docs/performance.md, "Memory headroom"):
+    #   m_acc = beta1*m_old + (1-beta1) * sum_k g_k
+    #   v_acc = beta2*v_old + (1-beta2) * sum_k g_k^2   (the AdamA
+    #           approximation: sum of squares, not square of sum)
+    # Normalization and clipping are linear in the accumulated increments,
+    # so they defer to the end; overflow unwinds algebraically (the final
+    # moments read (m_old, m_acc), so a skipped update keeps m_old bit-
+    # exactly — no partial fold survives).
+    # ------------------------------------------------------------------
+
+    @property
+    def supports_accum(self):
+        return True
+
+    def accum_init(self, slots):
+        # per-leaf on purpose: the accumulators initialize FROM the moment
+        # state, so under --zero-stage >= 1 they inherit its dp-sharded
+        # layout leaf by leaf — a flat carry was measured to cost a full
+        # parameter-buffer concatenate temp per fold (optim/multi_tensor.py,
+        # AdamA note)
+        beta1, beta2 = self.betas
+        return {
+            "m": jax.tree_util.tree_map(lambda m: beta1 * m, slots["m"]),
+            "v": jax.tree_util.tree_map(lambda v: beta2 * v, slots["v"]),
+        }
+
+    def accum_fold(self, acc, grads):
+        beta1, beta2 = self.betas
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads
+        )
+        return {
+            "m": jax.tree_util.tree_map(
+                lambda a, g: a + (1.0 - beta1) * g, acc["m"], g32
+            ),
+            "v": jax.tree_util.tree_map(
+                lambda a, g: a + (1.0 - beta2) * jnp.square(g), acc["v"], g32
+            ),
+        }
+
+    def accum_gnorm(self, acc, slots):
+        """||sum_k g_k|| recovered from the first-moment accumulator (no
+        gradient pytree needed); non-finite iff any micro-batch gradient
+        was — the adama overflow detector."""
+        beta1 = self.betas[0]
+        inv = 1.0 / (1.0 - beta1)
+        sq = sum(
+            jnp.sum(jnp.square((ma - beta1 * mo) * inv))
+            for ma, mo in zip(
+                jax.tree_util.tree_leaves(acc["m"]),
+                jax.tree_util.tree_leaves(slots["m"]),
+            )
+        )
+        return jnp.sqrt(sq)
+
+    def update_from_accum(
+        self, acc, state, params, lr, *, denom, clip_coef,
+        sr_rng=None, skip_update=None,
+    ):
+        """Finish an accumulated update: deferred normalize + clip folded
+        into the moment recovery, then the usual bias-corrected AdamW
+        param update and copy-back."""
+        beta1, beta2 = self.betas
+        step = state["step"] + 1
+        master = state["master"] if state["master"] is not None else params
+        decay_mask = self._decay_mask(params)
+        lr = jnp.asarray(lr, dtype=jnp.float32)
+        denom = jnp.asarray(denom, dtype=jnp.float32)
+        clip_coef = jnp.asarray(clip_coef, dtype=jnp.float32)
+
+        # per-leaf finish even under --fused-adam: this pass runs once per
+        # UPDATE (not per micro-batch), so the kernel-count argument for
+        # the flat form is weak, while flattening five trees here was
+        # measured to dominate the program's temp allocation — see the
+        # AdamA note in optim/multi_tensor.py
+        scale_m = clip_coef / denom
+        scale_v = scale_m * scale_m
+        new_m = jax.tree_util.tree_map(
+            lambda ma, mo: beta1 * mo + (ma - beta1 * mo) * scale_m,
+            acc["m"], state["slots"]["m"],
+        )
+        new_v = jax.tree_util.tree_map(
+            lambda va, vo: beta2 * vo + (va - beta2 * vo) * scale_v,
+            acc["v"], state["slots"]["v"],
+        )
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1 ** stepf
+        bc2 = 1.0 - beta2 ** stepf
+        step_size = lr * jnp.sqrt(bc2) / bc1
+        wd = self.weight_decay
+        eps = self.eps
+
+        def upd(m, v, p, d):
+            if wd != 0.0:
+                p = jnp.where(d, p * (1.0 - step_size * wd), p)
+            return p - step_size * (m / (jnp.sqrt(v) + eps))
+
+        new_master = jax.tree_util.tree_map(
+            upd, new_m, new_v, master, decay_mask
+        )
+        new_slots = {"m": new_m, "v": new_v}
+
+        return self._finalize(
+            new_master, new_slots, state, params, master, step, sr_rng,
+            skip_update,
+        )

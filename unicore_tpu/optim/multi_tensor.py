@@ -26,10 +26,19 @@ Numerics contract (tests/test_multi_tensor.py):
   different random stream than the tree path (divergence bounded by 1 bf16
   ulp per element).
 
-ZeRO-1 compatibility: the optimizer STATE stays a per-leaf pytree (same
+ZeRO compatibility: the optimizer STATE stays a per-leaf pytree (same
 checkpoint format, same ``zero1_pspecs`` sharding tree); flattening happens
 inside the jitted step, where GSPMD propagates the sharded layouts through
-the concatenate.
+the concatenate.  ``--zero-stage 2/3`` go further and shard the FLAT
+buffers themselves inside the fused pass: every buffer is zero-padded to a
+multiple of the data-axis size and pinned ``P('data')``, so XLA lowers the
+gradient psum into a reduce-scatter, each rank runs the elementwise Adam
+pass on its contiguous segment of the :class:`FlatPlan` table, and the
+updated params all-gather on the way back to their per-leaf output
+shardings (stage 3 additionally pins the fp32 master buffers, gathering
+on use).  The padding elements are zeros end to end — no reduction runs
+over the flat dim inside the pass, so stages 2/3 are bit-identical to the
+unsharded fused update (tests/test_memory_headroom.py).
 """
 
 from typing import Any, Dict, List, NamedTuple, Tuple
@@ -121,6 +130,89 @@ def bool_buffers(plan: FlatPlan, mask_tree) -> List[jnp.ndarray]:
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-2/3 flat-buffer sharding (--zero-stage)
+# ---------------------------------------------------------------------------
+
+def _zero_mesh():
+    """(mesh, data-axis size) when the flat-buffer sharding can engage,
+    else (None, 1) — the constraint helpers below degrade to identity.
+
+    Engages only on SINGLE-live-axis meshes (pure dp, the layout ZeRO
+    targets): jax 0.4.37's GSPMD corrupts a ``P('data')`` constraint on a
+    computed concatenate when the mesh carries a second live axis (the
+    same masked-materialization bug `_replicate_before_unflatten` shields
+    the output side from — repro pinned in tests/test_memory_headroom.py),
+    so on dp x tp/ep/... meshes stages 2/3 fall back to stage-1 semantics
+    with a one-shot warning instead of sharding wrong."""
+    from unicore_tpu.parallel.mesh import (
+        DATA_AXIS, get_global_mesh, warn_once,
+    )
+
+    mesh = get_global_mesh()
+    if mesh is None or mesh.shape.get(DATA_AXIS, 1) <= 1:
+        return None, 1
+    if sum(1 for n in mesh.shape.values() if n > 1) > 1:
+        import logging
+
+        warn_once(
+            logging.getLogger(__name__),
+            "--zero-stage 2/3 flat-buffer sharding is disabled on meshes "
+            "with more than one live axis (jax 0.4.37 GSPMD corrupts "
+            "sharded constraints on computed concatenates there — see "
+            "optim/multi_tensor.py:_zero_mesh); falling back to the "
+            "per-leaf stage-1 sharding for this run",
+        )
+        return None, 1
+    return mesh, mesh.shape[DATA_AXIS]
+
+
+def _pad_to(buf: jnp.ndarray, mult: int) -> jnp.ndarray:
+    """Zero-pad a 1-D buffer so its length divides ``mult`` (the data-axis
+    size) — the padding never feeds a reduction, so values are unchanged."""
+    rem = (-buf.shape[0]) % mult
+    if rem == 0:
+        return buf
+    return jnp.concatenate([buf, jnp.zeros((rem,), buf.dtype)])
+
+
+def _zero_shard(bufs: List[jnp.ndarray], mesh, ndata: int):
+    """Pad + pin flat buffers ``P('data')`` so each rank owns one
+    contiguous segment of the flat table (the reduce-scatter / sharded
+    update half of ZeRO-2/3)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from unicore_tpu.parallel.mesh import DATA_AXIS
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return [
+        jax.lax.with_sharding_constraint(_pad_to(b, ndata), sharding)
+        for b in bufs
+    ]
+
+
+def _replicate_before_unflatten(bufs: List[jnp.ndarray]):
+    """GSPMD workaround (jax 0.4.37): slicing a COMPUTED concatenate whose
+    consumer forces sharded jit outputs double-counts the values on meshes
+    with more than one live axis — the masked materialization all-reduces
+    over the replicated axes too (minimal repro pinned in
+    tests/test_memory_headroom.py::test_multi_axis_flat_unflatten_no_doubling).
+    Pinning the buffer REPLICATED before the unflatten slices forces a
+    correct materialization; per-leaf state is produced at this boundary
+    anyway (the ZeRO write-back all-gather), and single-live-axis meshes
+    (the common dp-only case) skip the constraint — their lowering is
+    correct and keeps the sharded layout end to end."""
+    from unicore_tpu.parallel.mesh import get_global_mesh
+
+    mesh = get_global_mesh()
+    if mesh is None or sum(1 for n in mesh.shape.values() if n > 1) < 2:
+        return bufs
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    return [jax.lax.with_sharding_constraint(b, rep) for b in bufs]
+
+
+# ---------------------------------------------------------------------------
 # fused passes
 # ---------------------------------------------------------------------------
 
@@ -154,16 +246,33 @@ def clip_grad_norm(grads, max_norm: float, eps: float = 1e-6):
 def fused_adam_update(
     grads32, slots, master, lr, step, decay_mask,
     *, beta1: float, beta2: float, eps: float, weight_decay: float,
+    zero_stage: int = 0,
 ):
     """One fused Adam(W) pass per flat buffer — per-element math identical
     to the tree_map path in :class:`~unicore_tpu.optim.adam.Adam`
-    (bit-parity proven in tests/test_multi_tensor.py)."""
+    (bit-parity proven in tests/test_multi_tensor.py).
+
+    ``zero_stage >= 2`` pins the flat grad/moment buffers ``P('data')``
+    (reduce-scatter in, segment update, all-gather out); ``3`` also pins
+    the fp32 master.  Padding is zeros and no reduction runs over the flat
+    dim, so the sharded update stays bit-identical."""
     plan = plan_for(grads32)
     g_bufs = flatten(plan, grads32)
     m_bufs = flatten(plan, slots["m"])
     v_bufs = flatten(plan, slots["v"])
     p_bufs = flatten(plan, master)
     d_bufs = bool_buffers(plan, decay_mask)
+
+    mesh, ndata = _zero_mesh() if zero_stage >= 2 else (None, 1)
+    if mesh is not None:
+        g_bufs = _zero_shard(g_bufs, mesh, ndata)
+        m_bufs = _zero_shard(m_bufs, mesh, ndata)
+        v_bufs = _zero_shard(v_bufs, mesh, ndata)
+        if zero_stage >= 3:
+            p_bufs = _zero_shard(p_bufs, mesh, ndata)
+        else:
+            p_bufs = [_pad_to(b, ndata) for b in p_bufs]
+        d_bufs = [_pad_to(b, ndata) for b in d_bufs]
 
     stepf = step.astype(jnp.float32)
     bc1 = 1.0 - beta1 ** stepf
@@ -181,10 +290,26 @@ def fused_adam_update(
         new_p.append(p)
         new_m.append(m)
         new_v.append(v)
+    new_p = _replicate_before_unflatten(new_p)
+    new_m = _replicate_before_unflatten(new_m)
+    new_v = _replicate_before_unflatten(new_v)
     return unflatten(plan, new_p), {
         "m": unflatten(plan, new_m),
         "v": unflatten(plan, new_v),
     }
+
+
+# NOTE on AdamA accumulation (--grad-accum adama, arXiv 2305.19982): the
+# moment ACCUMULATORS deliberately stay per-leaf pytrees (optim/adam.py)
+# rather than riding these flat buffers.  Measured on the compiled scan
+# program, a flat carry costs a full-parameter concatenate temp per
+# micro-batch fold (XLA materializes the concat; on backends that lower
+# psum+slice as all-reduce+dynamic-slice there is no reduce-scatter to
+# pay it back), while per-leaf adds fuse in place and INHERIT the
+# zero-stage sharding of the moment state they initialize from — the
+# carry peaks at ~2/dp of a parameter buffer under --zero-stage >= 1
+# against buffer mode's full replicated gradient carry
+# (tests/test_memory_headroom.py regression-checks the comparison).
 
 
 def fused_copy_back(new_master, params, sr_rng, bf16_sr: bool):
@@ -209,4 +334,4 @@ def fused_copy_back(new_master, params, sr_rng, bf16_sr: bool):
             out_bufs.append(fp32_to_bf16_sr(buf, key))
         else:
             out_bufs.append(buf.astype(g.dtype))
-    return unflatten(plan, out_bufs)
+    return unflatten(plan, _replicate_before_unflatten(out_bufs))

@@ -162,19 +162,33 @@ class UnicoreOptimizer(object):
             inv = 1.0 / jnp.asarray(grad_scale, dtype=jnp.float32)
             grads32 = jax.tree_util.tree_map(lambda g: g * inv, grads32)
 
+        decay_mask = self._decay_mask(params)
+        lr = jnp.asarray(lr, dtype=jnp.float32)
+        new_master, new_slots = self._apply_update(
+            grads32, state["slots"], master, lr, step, decay_mask
+        )
+        return self._finalize(
+            new_master, new_slots, state, params, master, step, sr_rng,
+            skip_update,
+        )
+
+    def _decay_mask(self, params):
         extra = tuple(
             n.strip().lower()
             for n in getattr(self.args, "no_weight_decay_names", "").split(",")
             if n.strip()
         )
-        decay_mask = make_decay_mask(
+        return make_decay_mask(
             params, ("bias", "layer_norm", "layernorm") + extra
         )
-        lr = jnp.asarray(lr, dtype=jnp.float32)
-        new_master, new_slots = self._apply_update(
-            grads32, state["slots"], master, lr, step, decay_mask
-        )
 
+    def _finalize(
+        self, new_master, new_slots, state, params, master, step, sr_rng,
+        skip_update,
+    ):
+        """Shared update tail: branchless overflow skip, master->param
+        copy-back, state packaging (used by :meth:`update` and the
+        accumulation-mode :meth:`update_from_accum` paths)."""
         if skip_update is not None:
             keep = lambda new, old: jax.tree_util.tree_map(
                 lambda n, o: jnp.where(skip_update, o, n), new, old
@@ -190,6 +204,17 @@ class UnicoreOptimizer(object):
             new_params = new_master
             new_state = {"step": step, "master": None, "slots": new_slots}
         return new_params, new_state
+
+    # ------------------------------------------------------------------
+    # AdamA-style accumulation (--grad-accum adama) — optional capability
+    # ------------------------------------------------------------------
+
+    @property
+    def supports_accum(self):
+        """True when the optimizer can fold micro-batch gradients straight
+        into its accumulator state (arXiv 2305.19982) instead of the
+        trainer carrying a full fp32 gradient pytree across the scan."""
+        return False
 
     # ------------------------------------------------------------------
     # host-side API parity helpers

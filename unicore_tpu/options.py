@@ -380,6 +380,25 @@ def get_parser(desc, default_task=None):
                              "FUSION-AUDIT JSON block through telemetry — "
                              "program-structure regressions are caught "
                              "without a device (docs/performance.md)")
+    parser.add_argument("--remat-policy", default=None,
+                        choices=["none", "all", "dots", "save-anything-pjit"],
+                        help="activation-rematerialization policy for the "
+                             "encoder stacks (jax.checkpoint_policies): "
+                             "'none' = save every activation (fastest, most "
+                             "memory); 'all' = recompute everything in the "
+                             "backward pass (nothing_saveable — the old "
+                             "--activation-checkpoint); 'dots' = save matmul "
+                             "outputs, recompute elementwise chains "
+                             "(dots_saveable — recompute is cheap, the MXU "
+                             "work is not); 'save-anything-pjit' = keep the "
+                             "checkpoint structure but save every saveable "
+                             "intermediate (save_anything_except_these_names "
+                             "with no names) — a no-recompute baseline that "
+                             "still gives GSPMD the region boundary to "
+                             "schedule collectives around.  Unset: follows "
+                             "the deprecated boolean --activation-checkpoint "
+                             "('all' when set, else 'none'); see "
+                             "docs/performance.md 'Memory headroom'")
     parser.add_argument("--fused-norm", default="auto",
                         choices=["auto", "on", "off"],
                         help="LayerNorm/RMSNorm kernel selection: 'on' = "
@@ -550,7 +569,42 @@ def add_distributed_training_args(parser, default_world_size=None):
     group.add_argument("--expert-parallel-size", type=int, default=1, metavar="N",
                        help="size of the 'expert' mesh axis for MoE layers")
     group.add_argument("--zero-shard-optimizer", action="store_true",
-                       help="shard fp32 master params + optimizer state over the data axis (ZeRO-1)")
+                       help="DEPRECATED alias for --zero-stage 1 (warns once; "
+                            "kept for script compatibility)")
+    group.add_argument("--zero-stage", type=int, default=0, choices=[0, 1, 2, 3],
+                       metavar="N",
+                       help="ZeRO optimizer-memory sharding over the data "
+                            "axis: 1 = fp32 master + moments sharded per "
+                            "leaf (the old --zero-shard-optimizer); 2 = "
+                            "additionally reduce-scatter the flat GRADIENT "
+                            "buffers inside the fused Adam pass (each rank "
+                            "updates its segment of the FlatPlan table, "
+                            "params all-gather on write-back); 3 = "
+                            "additionally shard the flat fp32 MASTER "
+                            "buffers with gather-on-use.  Stages 2/3 "
+                            "require --fused-adam (the flat buffers are "
+                            "what gets sharded); checkpoints stay per-leaf "
+                            "pytrees, so saves reshard freely across dp "
+                            "worlds on load (docs/performance.md, 'Memory "
+                            "headroom')")
+    group.add_argument("--grad-accum", default="buffer",
+                       choices=["buffer", "adama"],
+                       help="gradient-accumulation strategy for "
+                            "--update-freq > 1: 'buffer' carries a full "
+                            "fp32 gradient pytree across the micro-batch "
+                            "scan; 'adama' (arXiv 2305.19982) folds each "
+                            "micro-batch's gradient straight into the Adam "
+                            "moment accumulators, so no full gradient "
+                            "pytree is ever materialized across the scan "
+                            "(one param-size fp32 buffer of peak memory "
+                            "saved; under --zero-stage >= 1 the "
+                            "accumulators inherit the optimizer slots' "
+                            "per-leaf dp sharding).  "
+                            "Overflow contract: the fold is algebraically "
+                            "unwound — a non-finite micro-batch poisons "
+                            "only the accumulator, and the skipped update "
+                            "restores the pre-update moments exactly "
+                            "(docs/performance.md)")
     # robustness subsystem (distributed/guard.py, docs/robustness.md)
     group.add_argument("--consistency-check-interval", type=int, default=100,
                        metavar="N",

@@ -20,6 +20,7 @@ from .sharding import (  # noqa
     param_spec,
     params_pspecs,
     resolve_ddp_preset,
+    resolve_zero_stage,
     zero1_pspecs,
 )
 from .ring_attention import ring_attention, ring_self_attention  # noqa

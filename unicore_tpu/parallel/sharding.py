@@ -63,6 +63,35 @@ DEFAULT_PP_RULES = [
 DDP_BACKEND_CHOICES = ("c10d", "apex", "no_c10d", "legacy_ddp")
 
 
+_zero_shim_warned = False
+
+
+def resolve_zero_stage(args) -> int:
+    """ZeRO stage from the flags, honoring the deprecation shim:
+    ``--zero-shard-optimizer`` (the old boolean) means ``--zero-stage 1``
+    and warns once.  An explicit ``--zero-stage`` wins when both are set
+    (the boolean then adds nothing)."""
+    global _zero_shim_warned
+    stage = int(getattr(args, "zero_stage", 0) or 0)
+    if getattr(args, "zero_shard_optimizer", False):
+        if not _zero_shim_warned:
+            _zero_shim_warned = True
+            logger.warning(
+                "--zero-shard-optimizer is deprecated; use --zero-stage 1 "
+                "(stages 2/3 additionally shard the flat gradient / master "
+                "buffers — docs/performance.md, 'Memory headroom')"
+            )
+        stage = max(stage, 1)
+    if stage >= 2 and not getattr(args, "fused_adam", False):
+        raise ValueError(
+            f"--zero-stage {stage} shards the fused optimizer's flat "
+            "buffers and therefore requires --fused-adam (stages 2/3 have "
+            "no per-leaf equivalent; use --zero-stage 1 for the per-leaf "
+            "sharding)"
+        )
+    return stage
+
+
 def resolve_ddp_preset(args) -> str:
     """The sharding preset ``--ddp-backend`` (+ modifier flags) selects.
 
@@ -81,8 +110,9 @@ def resolve_ddp_preset(args) -> str:
             f"(choices: {', '.join(DDP_BACKEND_CHOICES)})"
         )
     layers = []
-    if getattr(args, "zero_shard_optimizer", False):
-        layers.append("zero1")
+    stage = resolve_zero_stage(args)
+    if stage > 0:
+        layers.append(f"zero{stage}")
     if getattr(args, "model_parallel_size", 1) > 1:
         layers.append("tensor_parallel")
     preset = "+".join(layers) if layers else "replicated"

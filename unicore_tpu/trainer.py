@@ -119,6 +119,24 @@ class Trainer(object):
         self._replicated = replicated(self.mesh)
 
         self._optimizer = build_optimizer(args)
+        # memory-headroom tier: ZeRO stage (1 = per-leaf master/moments
+        # sharding, 2/3 = flat-buffer grad/master sharding inside the fused
+        # pass — resolve also validates the --fused-adam requirement and
+        # fires the --zero-shard-optimizer deprecation warning) and the
+        # grad-accumulation strategy (docs/performance.md)
+        from unicore_tpu.parallel import resolve_zero_stage
+
+        self.zero_stage = resolve_zero_stage(args)
+        self.grad_accum_mode = getattr(args, "grad_accum", "buffer") or "buffer"
+        if self.grad_accum_mode == "adama" and not getattr(
+            self._optimizer, "supports_accum", False
+        ):
+            raise ValueError(
+                f"--grad-accum adama folds micro-batch gradients into the "
+                f"optimizer's moment accumulators, which "
+                f"{type(self._optimizer).__name__} does not support — use "
+                "--optimizer adam or --grad-accum buffer"
+            )
         total_train_steps = args.max_update if args.max_update > 0 else None
         self._lr_scheduler = lr_sched_mod.build_lr_scheduler(
             args, self._optimizer, total_train_steps
@@ -303,8 +321,11 @@ class Trainer(object):
         - params (and their mirrors: master, moments, EMA) follow the
           megatron-style TP rules when the mesh has a 'model' axis > 1,
           else replicate;
-        - with --zero-shard-optimizer, master/moments/EMA shard over the
-          'data' axis instead (ZeRO-1);
+        - with --zero-stage >= 1, master/moments/EMA shard over the 'data'
+          axis instead (per-leaf, largest divisible dim); stages 2/3
+          additionally shard the FLAT buffers inside the fused update
+          (optim/multi_tensor.py) — the at-rest state stays per-leaf so
+          checkpoints reshard freely across dp worlds;
         - scalars replicate.
         XLA emits all needed collectives from these annotations.
         """
@@ -313,7 +334,7 @@ class Trainer(object):
         use_tp = self.mesh.shape[MODEL_AXIS] > 1
         p_spec = params_pspecs(state["params"], use_tp=use_tp, mesh=self.mesh)
         p_shard = named(self.mesh, p_spec)
-        if getattr(self.args, "zero_shard_optimizer", False):
+        if self.zero_stage >= 1:
             m_shard = named(self.mesh, zero1_pspecs(state["params"], self.mesh))
         else:
             m_shard = p_shard
@@ -450,9 +471,85 @@ class Trainer(object):
             grads, gnorm = self._optimizer.clip_grad_norm(grads, clip_norm)
 
         overflow = ~jnp.isfinite(gnorm)
+        sched, pinned = self._sched_overflow(state, overflow)
+
+        sr_rng = jax.random.fold_in(rng, 1337)  # decorrelate SR from dropout
+        with jax.named_scope("optimizer"):
+            new_params, new_opt = self._optimizer.update(
+                grads,
+                state["opt"],
+                state["params"],
+                lr,
+                sr_rng=sr_rng,
+                skip_update=overflow,
+            )
+        new_state = self._package_update(
+            state, new_params, new_opt, sched, overflow
+        )
+        step_metrics = self._step_metrics(
+            logging_output, sample_size, gnorm, loss_scale, overflow,
+            pinned, clip_norm,
+        )
+        return new_state, step_metrics
+
+    def _apply_update_adama(self, state, acc, sample_size, logging_output,
+                            scalars, rng):
+        """Apply path for --grad-accum adama: the scan already folded every
+        micro-batch gradient into the moment accumulators ``acc``, so
+        normalize + clip defer into the moment recovery
+        (optim/adam.py:update_from_accum).  Overflow contract: any
+        non-finite micro-batch gradient makes the recovered grad norm
+        non-finite; the skip then restores the PRE-update moments exactly
+        (the fold is algebraically unwound), identical skip granularity to
+        buffer mode — a whole update, never a partial one."""
+        lr = scalars["lr"]
+        loss_scale = state["loss_scale"]
+        fault_mul = scalars["loss_mul"] * scalars["grad_mul"]
+        denom = jnp.maximum(sample_size, 1e-8) * loss_scale / fault_mul
+        if "loss" in logging_output:
+            logging_output = dict(logging_output)
+            logging_output["loss"] = logging_output["loss"] * scalars["loss_mul"]
+
+        clip_norm = getattr(self.args, "clip_norm", 0.0) or 0.0
+        opt = self._optimizer
+        with jax.named_scope("clip-grads"):
+            # ||sum_k g_k|| recovered from the m accumulator — the summed
+            # gradient itself is never materialized
+            gnorm = opt.accum_gnorm(acc, state["opt"]["slots"]) / denom
+        max_norm = jnp.asarray(clip_norm, dtype=gnorm.dtype)
+        clip_coef = jnp.where(
+            max_norm > 0, jnp.minimum(max_norm / (gnorm + 1e-6), 1.0), 1.0
+        )
+
+        overflow = ~jnp.isfinite(gnorm)
+        sched, pinned = self._sched_overflow(state, overflow)
+
+        sr_rng = jax.random.fold_in(rng, 1337)
+        with jax.named_scope("optimizer"):
+            new_params, new_opt = opt.update_from_accum(
+                acc,
+                state["opt"],
+                state["params"],
+                lr,
+                denom=denom,
+                clip_coef=clip_coef,
+                sr_rng=sr_rng,
+                skip_update=overflow,
+            )
+        new_state = self._package_update(
+            state, new_params, new_opt, sched, overflow
+        )
+        step_metrics = self._step_metrics(
+            logging_output, sample_size, gnorm, loss_scale, overflow,
+            pinned, clip_norm,
+        )
+        return new_state, step_metrics
+
+    def _sched_overflow(self, state, overflow):
+        """Loss-scale schedule step (branchless, in-jit)."""
         pinned = jnp.zeros((), dtype=jnp.bool_)
         sched = {
-            "scale": loss_scale,
+            "scale": state["loss_scale"],
             "since_overflow": state["since_overflow"],
             "since_rescale": state["since_rescale"],
             "overflows_since_rescale": state["overflows_since_rescale"],
@@ -470,17 +567,9 @@ class Trainer(object):
                     self.args, "threshold_loss_scale", None
                 ),
             )
+        return sched, pinned
 
-        sr_rng = jax.random.fold_in(rng, 1337)  # decorrelate SR from dropout
-        with jax.named_scope("optimizer"):
-            new_params, new_opt = self._optimizer.update(
-                grads,
-                state["opt"],
-                state["params"],
-                lr,
-                sr_rng=sr_rng,
-                skip_update=overflow,
-            )
+    def _package_update(self, state, new_params, new_opt, sched, overflow):
         new_state = {
             "params": new_params,
             "opt": new_opt,
@@ -497,7 +586,10 @@ class Trainer(object):
                 lambda e, o: jnp.where(overflow, o, e), ema, state["ema"]
             )
             new_state["ema"] = ema
+        return new_state
 
+    def _step_metrics(self, logging_output, sample_size, gnorm, loss_scale,
+                      overflow, pinned, clip_norm):
         step_metrics = dict(logging_output)
         step_metrics.update(
             {
@@ -518,7 +610,7 @@ class Trainer(object):
                 ),
             }
         )
-        return new_state, step_metrics
+        return step_metrics
 
     def _get_jit(self, name):
         if name in self._jit_cache:
@@ -625,6 +717,59 @@ class Trainer(object):
                 return new_state, accumulate(macc, step_metrics)
 
             fn = scan_step
+        elif name == "scan_step_adama":
+
+            @partial(jax.jit, donate_argnums=(0,) if donate else ())
+            def scan_step_adama(state, stacked, scalars, macc):
+                """--grad-accum adama (arXiv 2305.19982): the scan carries
+                the Adam moment ACCUMULATORS — each micro-batch's gradient
+                folds straight into them and is dead after its fold, so no
+                full fp32 gradient pytree ever lives across the scan.
+                Under --zero-stage >= 1 the accumulators inherit the
+                optimizer slots' per-leaf dp sharding (the stage-2/3 flat
+                reduce-scatter machinery applies to buffer mode only)."""
+                opt = self._optimizer
+                acc0 = opt.accum_init(state["opt"]["slots"])
+
+                def body(carry, xs):
+                    acc, acc_ss, acc_log = carry
+                    sample_k, micro_i = xs
+                    rng = make_rng(scalars, micro_i)
+                    grads, ss, log = self._forward_backward(
+                        state["params"], sample_k, rng, state["loss_scale"],
+                        scalars["weight"],
+                    )
+                    acc = opt.accum_fold(acc, grads)
+                    new_log = {k: acc_log[k] + log[k] for k in acc_log}
+                    return (acc, acc_ss + ss, new_log), None
+
+                with num_updates_context(scalars["step"]):
+                    probe_rng = make_rng(scalars, 0)
+                    _, _, probe_log = jax.eval_shape(
+                        lambda p, s: self._forward_backward(
+                            p, s, probe_rng, state["loss_scale"],
+                            scalars["weight"]
+                        ),
+                        state["params"],
+                        jax.tree_util.tree_map(lambda x: x[0], stacked),
+                    )
+                    zero_log = {
+                        k: jnp.zeros(v.shape, jnp.float32)
+                        for k, v in probe_log.items()
+                    }
+                    n_micro = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+                    (acc, ss, log), _ = jax.lax.scan(
+                        body,
+                        (acc0, jnp.zeros((), jnp.float32), zero_log),
+                        (stacked, jnp.arange(n_micro, dtype=jnp.int32)),
+                    )
+                rng = make_rng(scalars, 0)
+                new_state, step_metrics = self._apply_update_adama(
+                    state, acc, ss, log, scalars, rng
+                )
+                return new_state, accumulate(macc, step_metrics)
+
+            fn = scan_step_adama
         elif name == "micro_step":
 
             @partial(jax.jit, donate_argnums=(3,) if donate else ())
@@ -680,6 +825,13 @@ class Trainer(object):
             raise KeyError(name)
         self._jit_cache[name] = fn
         return fn
+
+    def _scan_jit_name(self):
+        """Which compiled program runs the stacked-micro-batch update."""
+        return (
+            "scan_step_adama" if self.grad_accum_mode == "adama"
+            else "scan_step"
+        )
 
     def _step_scalars(self, micro_i=0, weight=1.0, seed=None):
         """Small host->device scalar bundle for one step; everything else
@@ -759,10 +911,12 @@ class Trainer(object):
             f"moments{' + EMA' if self.use_ema else ''}): "
             f"{state_b / gib:.2f} GiB before activations\n"
             "  remedies: lower --batch-size; raise --update-freq (gradient "
-            "accumulation keeps the effective batch); enable "
-            "--activation-checkpoint; shard optimizer state with "
-            "--zero-shard-optimizer; or spread the model with "
-            "--model-parallel-size / --pipeline-parallel-size.\n"
+            "accumulation keeps the effective batch; add --grad-accum adama "
+            "so the accumulator never holds a full gradient pytree); "
+            "rematerialize activations with --remat-policy all|dots; shard "
+            "optimizer state with --zero-stage 1|2|3; or spread the model "
+            "with --model-parallel-size / --pipeline-parallel-size "
+            "(docs/performance.md, 'Memory headroom').\n"
             f"  original error: {str(err)[:800]}"
         )
 
@@ -817,7 +971,7 @@ class Trainer(object):
 
         state = self._state
         n = len(samples)
-        audit_args = None  # (sample, weight) for the one-shot --fusion-audit
+        audit_args = None  # (kind, payload) for the one-shot --fusion-audit
 
         with self._oom_guard(samples[0]):
             if prepared is not None:
@@ -844,7 +998,7 @@ class Trainer(object):
                 new_state, self._macc = self._get_jit("train_step")(
                     state, sample, self._step_scalars(0, weight), self._macc
                 )
-                audit_args = (sample, weight)
+                audit_args = ("single", (sample, weight))
             else:
                 if plan is not None and plan[0] is not None:
                     modes, sigs, stop_flags = plan
@@ -860,10 +1014,23 @@ class Trainer(object):
                 if stacked is not None:
                     # all micro-batches share shapes: ONE compiled program scans
                     # the whole accumulation (no per-micro-batch dispatch)
-                    new_state, self._macc = self._get_jit("scan_step")(
-                        state, stacked, self._step_scalars(0), self._macc
-                    )
+                    new_state, self._macc = self._get_jit(
+                        self._scan_jit_name()
+                    )(state, stacked, self._step_scalars(0), self._macc)
+                    audit_args = ("scan", stacked)
                 else:
+                    if self.grad_accum_mode == "adama":
+                        from unicore_tpu.parallel.mesh import warn_once
+
+                        warn_once(
+                            logger,
+                            "--grad-accum adama engages only on the "
+                            "stacked-scan accumulation path; this update's "
+                            "micro-batches have mixed geometry, so it falls "
+                            "back to buffer-mode sequential micro-steps "
+                            "(bound the shape set with --length-bucket to "
+                            "keep adama engaged)",
+                        )
                     acc = None
                     micro = self._get_jit("micro_step")
                     for i, s in enumerate(samples):
@@ -908,12 +1075,17 @@ class Trainer(object):
         ):
             self._fusion_audit_done = True
             if audit_args is not None:
-                self.fusion_audit(*audit_args)
+                kind, payload = audit_args
+                if kind == "single":
+                    self.fusion_audit(*payload)
+                else:
+                    self.fusion_audit_scan(payload)
             else:
                 logger.warning(
-                    "fusion-audit: only the update-freq-1 synchronous train "
-                    "step is audited; this run dispatches a different "
-                    "program (prefetch/grad-accum) — audit skipped"
+                    "fusion-audit: only the synchronous train-step programs "
+                    "(update-freq 1, or the stacked grad-accum scan) are "
+                    "audited; this run dispatches a different program "
+                    "(prefetch/mixed-geometry micro-steps) — audit skipped"
                 )
         # cross-host fingerprint check every --consistency-check-interval
         # updates (multi-host only; raises ConsistencyError naming the
@@ -956,7 +1128,7 @@ class Trainer(object):
                 self._macc,
             )
         if item.kind == "scan":
-            return self._get_jit("scan_step")(
+            return self._get_jit(self._scan_jit_name())(
                 state, item.data, self._step_scalars(0), self._macc
             )
         assert item.kind == "micro", item.kind
@@ -1059,16 +1231,32 @@ class Trainer(object):
         ``fusion-audit`` telemetry event.  Returns the report dict (None
         when the program/HLO is unavailable — auditing never raises into
         the training loop)."""
+        return self._fusion_audit_program(
+            "train_step",
+            (self._state, sample, self._step_scalars(0, weight), self._macc),
+            top_n,
+        )
+
+    def fusion_audit_scan(self, stacked, top_n: int = 5):
+        """Fusion audit of the grad-accumulation scan program (buffer or
+        adama mode) — the program whose peak-memory section the memory-
+        headroom regression checks compare across
+        {zero-stage} x {grad-accum} (docs/performance.md)."""
+        return self._fusion_audit_program(
+            self._scan_jit_name(),
+            (self._state, stacked, self._step_scalars(0), self._macc),
+            top_n,
+        )
+
+    def _fusion_audit_program(self, name, call_args, top_n):
         from unicore_tpu.analysis import fusion_audit as _fa
 
-        fn = self._jit_cache.get("train_step")
+        fn = self._jit_cache.get(name)
         if fn is None:
-            logger.warning("fusion-audit: no compiled train_step program")
+            logger.warning(f"fusion-audit: no compiled {name} program")
             return None
         try:
-            lowered = fn.lower(
-                self._state, sample, self._step_scalars(0, weight), self._macc
-            )
+            lowered = fn.lower(*call_args)
             compiled = lowered.compile()
         except Exception as e:
             logger.warning(f"fusion-audit: compile failed: {e!r}")
@@ -1077,6 +1265,7 @@ class Trainer(object):
         if report is None:
             logger.warning("fusion-audit: executable exposes no HLO text")
             return None
+        report["program"] = name
         telemetry.emit("fusion-audit", **report)
         logger.info(_fa.format_report(report))
         return report
@@ -1084,8 +1273,8 @@ class Trainer(object):
     #: jit-cache entries that make up the TRAIN step (valid_step compiles
     #: are expected at each new validation geometry and don't gate the
     #: one-program-per-update promise)
-    _TRAIN_PROGRAM_KEYS = ("train_step", "scan_step", "micro_step",
-                           "apply_step")
+    _TRAIN_PROGRAM_KEYS = ("train_step", "scan_step", "scan_step_adama",
+                           "micro_step", "apply_step")
 
     def _count_compiled_programs(self) -> int:
         """Total compiled-executable count across the train-step jit
@@ -2039,6 +2228,13 @@ class Trainer(object):
                 )
             extra_state = state.get("extra_state", None)
             last_optim_state = state.get("optimizer_state", None)
+            # ZeRO resharding across dp worlds: checkpoints are per-leaf
+            # pytrees, so loading onto a different mesh just re-lays the
+            # leaves out under the CURRENT shardings — the v2 header's
+            # process-count/mesh provenance makes the reshard visible
+            self._log_checkpoint_reshard(
+                os.path.join(filename, "meta.pk") if is_orbax else filename
+            )
             # elastic runs only: a checkpoint written by a NEWER membership
             # epoch proves THIS host is a stale incarnation rejoining — a
             # named, fatal refusal beats silently rewinding the cluster
@@ -2106,6 +2302,31 @@ class Trainer(object):
         else:
             logger.info(f"No existing checkpoint found {filename}")
         return extra_state
+
+    def _log_checkpoint_reshard(self, header_path):
+        """INFO-log when a checkpoint's v2-header topology (writer mesh /
+        process count) differs from the current run's — the per-leaf state
+        reshards losslessly, but operators should see it happening
+        (best-effort: legacy/v1 files carry no topology)."""
+        try:
+            from unicore_tpu.checkpoint import format as ckpt_format
+
+            if not ckpt_format.is_v2(header_path):
+                return
+            hdr = ckpt_format.read_header(header_path)
+        except Exception:
+            return
+        saved_mesh = hdr.get("mesh")
+        saved_pc = hdr.get("process_count")
+        cur_mesh = dict(self.mesh.shape)
+        if saved_mesh and dict(saved_mesh) != cur_mesh:
+            logger.info(
+                f"checkpoint was written on mesh {dict(saved_mesh)} "
+                f"({saved_pc} process(es)); resharding per-leaf state onto "
+                f"mesh {cur_mesh} ({jax.process_count()} process(es)) at "
+                "load (ZeRO state is per-leaf in checkpoints, so this is "
+                "lossless)"
+            )
 
     def _merge_checkpoint(self, state, reset_optimizer=False):
         load_ema = getattr(self.args, "load_from_ema", False)
