@@ -17,6 +17,13 @@ seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
                             offered load just under the shedding point —
                             req/s + p50/p90/p99 latency rows
                             (BENCH_SERVE_SECONDS, BENCH_SERVE_BUCKETS)
+    BENCH_CONFIG=serve-quant  int8 vs bf16 serving: two engines over the
+                            SAME weights driven by the SAME paced offered
+                            load (BENCH_QUANT_QPS, default 50 req/s) —
+                            one req/s + p99 row per precision, each
+                            carrying the calibration drift bound
+                            (BENCH_QUANT_LAYERS/EMBED size the model;
+                            docs/serving.md "Quantized inference")
     BENCH_CONFIG=kernels    device-side fused-kernel shootout: one row per
                             op pair — softmax_dropout jnp-vs-Pallas,
                             layernorm jnp-vs-Pallas, Adam tree_map-vs-fused
@@ -625,6 +632,147 @@ def run_serve_bench():
 
 
 # ---------------------------------------------------------------------------
+# quantized serving (BENCH_CONFIG=serve-quant): int8 vs bf16, same load
+# ---------------------------------------------------------------------------
+
+def run_serve_quant_bench():
+    """int8 vs bf16 serving throughput at IDENTICAL offered load
+    (docs/serving.md "Quantized inference"): two engines over the same
+    model/weights — one bf16-cast, one calibrate.prepare()d int8 — each
+    driven by the same paced request schedule (BENCH_QUANT_QPS), so the
+    req/s + p99 rows compare precision paths, not admission luck.  Rows
+    carry the calibration drift bound so throughput is never quoted
+    without its quality contract.  CPU fallback rows are labeled like
+    every other config — liveness proof, not a perf claim."""
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu.checkpoint.emergency import Deadline
+    from unicore_tpu.data.data_utils import compute_length_buckets
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.quant import calibrate
+    from unicore_tpu.serve import ServeEngine, build_infer_fn
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
+    n_buckets = int(os.environ.get("BENCH_SERVE_BUCKETS", "2"))
+    duration = float(os.environ.get("BENCH_SERVE_SECONDS", "10"))
+    qps = float(os.environ.get("BENCH_QUANT_QPS", "50"))
+    layers = int(os.environ.get("BENCH_QUANT_LAYERS", "4"))
+    embed = int(os.environ.get("BENCH_QUANT_EMBED", "256"))
+    vocab = 30522
+
+    model = BertModel(
+        vocab_size=vocab,
+        padding_idx=1,
+        encoder_layers=layers,
+        encoder_embed_dim=embed,
+        encoder_ffn_embed_dim=4 * embed,
+        encoder_attention_heads=max(4, embed // 64),
+        max_seq_len=seq_len,
+        post_ln=True,
+    )
+    rng = np.random.RandomState(0)
+    sample = {
+        "net_input": {
+            "src_tokens": rng.randint(
+                4, vocab, size=(batch_size, seq_len)
+            ).astype(np.int64)
+        }
+    }
+    variables = model.init_params(jax.random.PRNGKey(0), sample)
+    edges = compute_length_buckets(n_buckets, seq_len) or (seq_len,)
+
+    def to_bf16(x):
+        x = jnp.asarray(x)
+        return x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x
+
+    arms = [("bf16", model, jax.tree_util.tree_map(to_bf16, variables),
+             None)]
+    model_q = model.clone(quantize="int8")
+    prepared, qinfo = calibrate.calibrate_for_serving(
+        model_q, model, variables, mode="int8", snapshot_path=None,
+        vocab_size=vocab, pad_idx=1, bucket_edges=edges,
+        batch_size=batch_size, persist=False,
+    )
+    arms.append(("int8", model_q, jax.device_put(prepared), qinfo))
+
+    last = None
+    for precision, m, v, arm_qinfo in arms:
+        infer_fn, cache_probe = build_infer_fn(m)
+        engine = ServeEngine(
+            v,
+            infer_fn,
+            bucket_edges=edges,
+            batch_size=batch_size,
+            pad_idx=1,
+            admission_capacity=max(64, batch_size * 8),
+            cache_size_probe=cache_probe,
+            precision=precision,
+        )
+        programs = engine.warmup()
+        engine.start()
+        lengths = [max(1, e - 1) for e in edges]
+        t0 = time.perf_counter()
+        t_end = t0 + duration
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            # identical offered schedule per arm: request i is DUE at
+            # t0 + i/qps regardless of how this arm is keeping up
+            target = t0 + i / qps
+            if now < target:
+                time.sleep(min(target - now, 0.01))
+                continue
+            engine.submit([5] * lengths[i % len(lengths)], 600.0)
+            i += 1
+        engine.drain(Deadline(300.0))
+        elapsed = time.perf_counter() - t0
+
+        stats = engine.stats()
+        row = {
+            "metric": (
+                f"serve_quant_bert_l{layers}e{embed}_seq{seq_len}_"
+                f"{precision}_req_per_sec"
+            ),
+            "value": round(stats["served"] / elapsed, 2),
+            "unit": "req/s",
+            "vs_baseline": None,
+            "precision": precision,
+            "offered_qps": qps,
+            "offered": i,
+            "served": stats["served"],
+            "shed": sum(stats["shed"].values()),
+            "batches": stats["batches"],
+            "bucket_programs": programs,
+            "recompiles_after_warmup": stats["recompiles_after_warmup"],
+            "encoder_layers": layers,
+            "embed_dim": embed,
+        }
+        for k in ("p50_ms", "p90_ms", "p99_ms"):
+            if k in stats:
+                row[k] = stats[k]
+        if arm_qinfo is not None:
+            row["quant_rel_drift"] = round(arm_qinfo["rel_drift"], 6)
+            row["quant_sites"] = arm_qinfo["sites"]
+        _append_partial(row)  # raw number first — diagnostics can hang
+        if os.environ.get("BENCH_CPU_FALLBACK"):
+            row["cpu_fallback"] = True
+        try:
+            row["device_kind"] = jax.devices()[0].device_kind
+        except Exception as e:
+            sys.stderr.write(
+                f"bench: diagnostics failed (result kept): {e!r}\n"
+            )
+        _append_partial(row)
+        print(json.dumps(row), flush=True)
+        last = row
+    return last
+
+
+# ---------------------------------------------------------------------------
 # fused-kernel shootout (BENCH_CONFIG=kernels)
 # ---------------------------------------------------------------------------
 
@@ -1090,6 +1238,8 @@ def main():
         try:
             if c == "serve":
                 runner = run_serve_bench
+            elif c == "serve-quant":
+                runner = run_serve_quant_bench
             elif c == "kernels":
                 runner = run_kernel_bench
             elif c == "memory":

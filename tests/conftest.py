@@ -50,6 +50,7 @@ _FAST_FILES = {
     "test_fusion_audit.py",
     "test_serve.py",
     "test_telemetry.py",
+    "test_quant.py",
 }
 
 

@@ -24,6 +24,7 @@ from unicore_tpu.serve import request as rq
 from unicore_tpu.serve.admission import AdmissionQueue
 from unicore_tpu.serve.engine import ServeEngine
 from unicore_tpu.serve.reload import (
+    OUTCOME_REJECTED_CALIBRATION,
     OUTCOME_REJECTED_PROBE,
     OUTCOME_REJECTED_STRUCTURE,
     OUTCOME_REJECTED_VERIFY,
@@ -125,6 +126,56 @@ def test_estimated_delay_math():
         assert q.admit(rq.ServeRequest.make([2, 3], 1000.0))
     # 8 queued + this one = 9 -> ceil(9/4) = 3 batches ahead
     assert q.estimated_delay() == pytest.approx(6.0)
+
+
+def test_per_bucket_service_ema_keys_the_shed_estimate():
+    """Regression: with buckets of very different sequence lengths the
+    deadline-unmeetable estimate must price each request at ITS OWN
+    (bucket, precision) program's service EMA, not one blended global
+    EMA — a global EMA overcharges short requests queued behind long
+    ones (false sheds) and undercharges the reverse (false admits)."""
+    q = AdmissionQueue(capacity=64, batch_capacity=4, max_len=512,
+                       bucket_edges=(16, 512), precision="int8")
+    q.set_accepting(True)
+    q.note_batch_service(10.0, bucket=512)  # slow long-seq program
+    q.note_batch_service(0.01, bucket=16)   # fast short-seq program
+    for _ in range(4):  # one full batch of slow requests queued ahead
+        assert q.admit(rq.ServeRequest.make([2] * 500, 60.0))
+    # short request behind them: 1 slow batch + its own fast batch
+    assert q.estimated_delay(length=10) == pytest.approx(10.01)
+    # long request: joins the slow bucket -> ceil(5/4) = 2 slow batches
+    assert q.estimated_delay(length=500) == pytest.approx(20.0)
+    # the deadline gate follows: a 12s short request is meetable...
+    fast = rq.ServeRequest.make([2] * 10, 12.0)
+    assert q.admit(fast)
+    # ...while a 12s long one is not (queue state: 4 slow + 1 fast)
+    slow = rq.ServeRequest.make([2] * 500, 12.0)
+    assert not q.admit(slow)
+    assert slow.response.reason == rq.SHED_DEADLINE_UNMEETABLE
+
+
+def test_bucket_without_sample_falls_back_to_global_ema():
+    q = AdmissionQueue(capacity=64, batch_capacity=4, max_len=512,
+                       bucket_edges=(16, 512), precision="int8")
+    q.set_accepting(True)
+    q.note_batch_service(2.0, bucket=512)
+    # bucket 16 has no sample yet: its estimate borrows the global EMA
+    # (blind-but-bounded beats shedding on a zero estimate)
+    assert q.estimated_delay(length=10) == pytest.approx(2.0)
+    # once the bucket gets its own sample, it stops borrowing
+    q.note_batch_service(0.25, bucket=16)
+    assert q.estimated_delay(length=10) == pytest.approx(0.25)
+
+
+def test_engine_feeds_per_bucket_service_samples():
+    eng = make_engine(edges=(16, 32), batch=2)
+    eng.warmup()  # seeds every bucket's EMA from the warm dispatch
+    assert set(eng.queue._service_ema_by_key) == {
+        (16, ""), (32, ""),
+    }
+    eng.submit([2] * 10, 30.0)
+    eng.step(timeout=0.2)
+    assert eng.queue._service_ema_by_key[(16, "")] is not None
 
 
 def test_deadline_unmeetable_sheds_at_admission():
@@ -358,6 +409,163 @@ def test_reload_structure_mismatch_rolls_back():
     # no model tree at all
     hr2 = HotReloader(eng, loader=lambda p: {}, prober=lambda v: None)
     assert hr2.consider("/fake/c.pt") == OUTCOME_REJECTED_STRUCTURE
+
+
+def test_reload_calibration_failure_rolls_back_named():
+    """Quantized serving: a candidate whose scales can't be re-verified
+    or re-derived is a NAMED rejected:calibration rollback — the serving
+    snapshot (and its scales) keep serving."""
+    eng = make_engine()
+    eng.warmup()
+    old = eng.variables
+
+    def bad_preparer(variables):
+        from unicore_tpu.quant.calibrate import CalibrationError
+
+        raise CalibrationError(
+            "persisted scales digest-mismatch AND re-calibration produced "
+            "a non-finite absmax"
+        )
+
+    hr = HotReloader(
+        eng, loader=lambda p: _good_state(eng), prober=lambda v: None,
+        preparer=bad_preparer, structure_ref=eng.variables,
+    )
+    assert hr.consider("/fake/c.pt") == OUTCOME_REJECTED_CALIBRATION
+    assert eng.variables is old
+    assert eng.ready() and eng.phase == "serving"
+    assert hr.rolled_back == 1 and hr.swapped == 0
+    # the server keeps serving on the old snapshot
+    r = eng.submit([2, 3], 10.0)
+    eng.step(timeout=0.2)
+    assert r.response.status == rq.STATUS_OK
+
+
+def test_reload_preparer_output_is_what_probes_and_swaps():
+    """The probe and the swap must see the PREPARED (quantized) tree, not
+    the raw fp32 candidate — and the structure check must run against the
+    fp32 reference, because the engine's live tree has quantized leaves."""
+    eng = make_engine()
+    eng.warmup()
+    fp32_ref = {"params": {"w": np.zeros((2, 2))}}
+    prepared_tree = {"params": {"w_q": np.ones((2, 2), np.int8),
+                                "w_scale": np.ones((2,), np.float32)}}
+    probed = []
+    candidate_state = _good_state(eng)  # fp32-shaped candidate
+    hr = HotReloader(
+        eng, loader=lambda p: candidate_state,
+        prober=probed.append,
+        preparer=lambda v: prepared_tree,
+        structure_ref=fp32_ref,
+    )
+    # make the engine's live tree quantized-shaped (≠ candidate structure):
+    # without structure_ref this candidate would be falsely rejected
+    eng.variables = prepared_tree
+    assert hr.consider("/fake/c.pt") == OUTCOME_SWAPPED
+    assert probed == [prepared_tree]
+    eng.submit([2, 3], 10.0)
+    eng._apply_pending_swap()
+    assert eng.variables is prepared_tree
+
+
+def test_reload_probe_rejection_releases_prepared_staging():
+    """A candidate rejected at the PROBE stage has already run the
+    preparer (drift-oracle pair staged, device trees resident) —
+    preparer_abort must release that staging so a rejected candidate
+    neither leaks nor ever re-pairs the drift oracle."""
+    eng = make_engine()
+    eng.warmup()
+    staged = []
+    aborted = []
+
+    def preparer(variables):
+        staged.append(variables)
+        return variables
+
+    def bad_prober(variables):
+        raise RuntimeError("non-finite score canary")
+
+    hr = HotReloader(
+        eng, loader=lambda p: _good_state(eng), prober=bad_prober,
+        preparer=preparer, preparer_abort=lambda: aborted.append(True),
+        structure_ref=eng.variables,
+    )
+    assert hr.consider("/fake/c.pt") == OUTCOME_REJECTED_PROBE
+    assert staged and aborted == [True]
+    assert eng.ready() and eng.phase == "serving"
+    # without a preparer the abort hook is never invoked (fp path)
+    aborted.clear()
+    hr2 = HotReloader(
+        eng, loader=lambda p: _good_state(eng), prober=bad_prober,
+        preparer_abort=lambda: aborted.append(True),
+    )
+    assert hr2.consider("/fake/c2.pt") == OUTCOME_REJECTED_PROBE
+    assert aborted == []
+
+
+def test_engine_swap_hook_fires_on_applied_swap():
+    fired = []
+    eng = make_engine()
+    eng._swap_hook = lambda variables, tag: fired.append((variables, tag))
+    eng.warmup()
+    new_vars = {"params": {"w": np.ones((2, 2))}}
+    eng.request_swap(new_vars, tag="t1")
+    eng._apply_pending_swap()
+    assert fired and fired[0][0] is new_vars
+
+
+def test_update_quant_info_refreshes_stats_and_resets_drift():
+    """After a hot swap commits a re-calibrated snapshot, /stats must
+    describe the snapshot actually serving: the calibration block is
+    replaced and the request-drift aggregate starts over (a monotonic
+    max spanning swaps would report a dead snapshot's worst sample)."""
+    eng = make_engine()
+    eng.quant_info = {"mode": "int8", "source": "calibrated",
+                      "rel_drift": 0.01}
+    eng._drift["samples"] = 7
+    eng._drift["max_abs"] = 0.5
+    eng.update_quant_info({"mode": "int8", "source": "reused-verified",
+                           "rel_drift": 0.04})
+    q = eng.stats()["quant"]
+    assert q["source"] == "reused-verified" and q["rel_drift"] == 0.04
+    assert q["request_drift"] == {"samples": 0, "max_abs": 0.0,
+                                  "mean_abs": 0.0, "last_abs": 0.0}
+
+
+def test_engine_sampled_drift_probe_aggregates_per_request():
+    """Quantized serving's per-request logit-drift stats: every N-th
+    batch runs the shadow oracle and the per-REAL-row max |drift| lands
+    in /stats under quant.request_drift."""
+    eng = ServeEngine(
+        {"params": {"w": np.zeros((2, 2))}},
+        fake_infer(),
+        bucket_edges=(16,),
+        batch_size=4,
+        pad_idx=1,
+        admission_capacity=8,
+        precision="int8",
+        quant_info={"mode": "int8", "sites": 3},
+        drift_probe=lambda arr: np.full(arr.shape[0], 0.25, np.float32),
+        drift_sample_every=1,
+    )
+    eng.warmup()
+    eng.submit([2, 3], 10.0)
+    eng.step(timeout=0.2)
+    stats = eng.stats()
+    assert stats["precision"] == "int8"
+    drift = stats["quant"]["request_drift"]
+    assert drift["samples"] == 1  # one REAL row (padding rows excluded)
+    assert drift["max_abs"] == pytest.approx(0.25)
+
+    # a dying probe disables itself and never takes the loop down
+    def boom(arr):
+        raise RuntimeError("oracle OOM")
+
+    eng._drift_probe = boom
+    eng._drift_probe_dead = False
+    eng.submit([2, 3], 10.0)
+    assert eng.step(timeout=0.2) == 1
+    assert eng._drift_probe_dead
 
 
 def test_engine_probe_rejects_poisoned_weights():
@@ -874,3 +1082,102 @@ def test_cli_serve_corrupt_reload_keeps_serving(served_checkpoint, tmp_path):
         rc = sp.sigterm_and_wait(120 * _SCALE)
     sys.stdout.write(sp.log())  # CI smoke greps the serve log via pytest -s
     assert rc == 0, sp.log()[-4000:]
+
+
+@pytest.mark.slow
+def test_cli_serve_quantized_int8_e2e(served_checkpoint, tmp_path):
+    """Quantized-serving acceptance e2e: ``--serve-quantize int8``
+    calibrates at startup (QUANT-PATH line, scales persisted beside the
+    snapshot), floods shed with the SAME named reasons as the bf16 path,
+    sampled per-request logit drift stays under the documented int8
+    bound, hot reload re-verifies scales before swapping, steady state
+    compiles nothing after warm-up, and SIGTERM drains to exit 0."""
+    import shutil
+
+    ckpt_dir = tmp_path / "ckpt"
+    ckpt_dir.mkdir()
+    live = ckpt_dir / "checkpoint_last.pt"
+    shutil.copy(served_checkpoint, live)
+    pristine = tmp_path / "pristine.pt"
+    shutil.copy(served_checkpoint, pristine)
+    deadline_ms = 2000.0
+    sp = ServeProc(tmp_path, [
+        "--path", str(live),
+        "--port", "0", "--serve-batch-size", "2", "--serve-buckets", "2",
+        "--admission-capacity", "16",
+        "--serve-quantize", "int8",
+        "--quant-drift-sample", "1",
+        "--default-deadline-ms", str(deadline_ms),
+        "--reload-interval", "0.5",
+        "--drain-deadline", str(60 * _SCALE),
+        "--fault-inject", "request-flood:2000@0",
+        "--jax-compilation-cache-dir", _JAX_CACHE,
+    ])
+    try:
+        sp.wait_listening(120 * _SCALE)  # calibration runs before bind
+        sp.wait_ready(240 * _SCALE)
+        # calibration persisted the digest-tied scales beside the snapshot
+        scales = ckpt_dir / "checkpoint_last.pt.quant-scales.json"
+        assert scales.exists()
+        _post(
+            sp.base + "/v1/infer",
+            {"tokens": [5, 6, 7], "deadline_ms": 5000},
+        )
+        deadline = time.monotonic() + 90 * _SCALE
+        stats = {}
+        while time.monotonic() < deadline:
+            _, stats = _get(sp.base + "/stats")
+            if stats.get("shed") and stats.get("served"):
+                break
+            time.sleep(0.5)
+        # shedding behaves exactly like the bf16 e2e: named reasons only
+        assert stats.get("shed"), f"flood never shed: {stats}"
+        assert set(stats["shed"]) & {"queue-full", "deadline-unmeetable"}, \
+            stats
+        assert stats["precision"] == "int8"
+        quant = stats.get("quant") or {}
+        assert quant.get("mode") == "int8"
+        # the documented int8 error bound (docs/serving.md): calibration
+        # drift under 5% of the fp32 logit absmax
+        assert quant.get("rel_drift", 1.0) < 0.05, quant
+        # hot reload: republish the same weights — the reload candidate
+        # re-verifies the persisted scales (digest match) before any swap
+        staged = ckpt_dir / ".staged.tmp"
+        shutil.copy(pristine, staged)
+        os.replace(staged, live)
+        deadline = time.monotonic() + 90 * _SCALE
+        while time.monotonic() < deadline:
+            if "RELOAD VERIFIED" in sp.log():
+                break
+            time.sleep(0.5)
+        log = sp.log()
+        assert "RELOAD VERIFIED" in log, log[-3000:]
+        assert "reload candidate re-calibrated" in log, log[-3000:]
+        # the flood's backlog may still be draining (it legitimately
+        # sheds new work); a patient request must get through once the
+        # queue clears — this also forces the batch boundary the swap
+        # lands on
+        deadline = time.monotonic() + 120 * _SCALE
+        code = None
+        while time.monotonic() < deadline:
+            code, _ = _post(
+                sp.base + "/v1/infer",
+                {"tokens": [8, 9], "deadline_ms": 60000},
+            )
+            if code == 200:
+                break
+            time.sleep(1.0)
+        assert code == 200, f"post-reload request never served ({code})"
+        _, stats = _get(sp.base + "/stats")
+        drift = (stats.get("quant") or {}).get("request_drift", {})
+    finally:
+        rc = sp.sigterm_and_wait(120 * _SCALE)
+    log = sp.log()
+    sys.stdout.write(log)  # CI smoke greps the serve log via pytest -s
+    assert rc == 0, log[-4000:]
+    assert "QUANT-PATH int8" in log
+    assert "recompile after warmup" not in log
+    # sampled per-request drift held the (2x-margin, unseen-data) bound
+    if drift.get("samples"):
+        ref_absmax = max(float(quant.get("ref_logit_absmax", 0.0)), 1e-8)
+        assert drift["max_abs"] < 2 * 0.05 * ref_absmax, (drift, quant)

@@ -15,6 +15,16 @@ walk the optimized HLO, and report
   ops still sitting at computation level, i.e. fusion opportunities XLA
   declined — the first place to look when a "fused" change didn't shrink
   the program,
+- a **dequant section** (``dequant``): materialized dequantization
+  intermediates in a quantized program — computation-level ``convert``
+  instructions from a quantized storage dtype (s8/s32 accumulator/f8) up
+  to a float compute dtype, and the worse form, such a convert whose
+  result feeds a computation-level ``multiply`` (the classic unfused
+  dequant chain: write the fp32 tensor to HBM, read it back to scale it).
+  The quantized serving path's contract (arXiv 2502.17728; docs/serving.md
+  "Quantized inference") is ``unfused_chains == 0``: every dequant
+  multiply lives INSIDE the fusion that consumes it — regression-checked
+  device-free by tests/test_quant.py,
 - a **peak-memory section** (``memory``): the compiler's own per-device
   allocation stats — argument / output / temp / aliased bytes plus
   ``peak_bytes`` (argument + output + temp − alias, the static upper bound
@@ -119,6 +129,8 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
     fusions = []
     fusion_kinds: Dict[str, int] = {}
     chains: List[Dict] = []
+    dequant_converts: List[str] = []
+    dequant_chains: List[str] = []
 
     for comp in comps:
         if comp["name"] in called:
@@ -143,6 +155,9 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
                     "bytes": _shape_bytes(line.split(", kind=")[0]),
                 })
         chains.extend(_elementwise_chains(instrs))
+        cv, ch = _dequant_chains(instrs)
+        dequant_converts.extend(cv)
+        dequant_chains.extend(ch)
 
     fusions.sort(key=lambda f: -f["bytes"])
     chains.sort(key=lambda c: -c["length"])
@@ -155,7 +170,61 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
         "top_fusions": fusions[:top_n],
         "unfused_elementwise": sum(c["length"] for c in chains),
         "top_unfused_chains": chains[:top_n],
+        "dequant": {
+            "materialized_converts": len(dequant_converts),
+            "unfused_chains": len(dequant_chains),
+            "examples": sorted(dequant_chains)[:top_n],
+        },
     }
+
+
+#: quantized storage/accumulator dtypes whose upcast IS a dequantization
+_QUANT_SRC_DTYPES = frozenset({"s8", "u8", "s32", "f8e4m3fn", "f8e5m2"})
+_FLOAT_DST_DTYPES = frozenset({"f32", "bf16", "f16"})
+
+
+def _result_dtype(shape_text: str) -> Optional[str]:
+    m = _SHAPE_RE.search(shape_text)
+    return m.group(1) if m else None
+
+
+def _dequant_chains(instrs) -> tuple:
+    """Materialized dequant intermediates among computation-level
+    instructions: ``converts`` — unfused quantized->float converts
+    (each one writes a full float tensor to HBM); ``chains`` — the worse
+    form, a convert whose result then feeds a computation-level
+    ``multiply`` (the textbook dequantize-then-scale pair the quantized
+    kernels exist to eliminate).  Fused programs keep both inside fusion
+    bodies, which live in called computations and never reach here."""
+    by_name = {}
+    for name, opcode, line in instrs:
+        m = _INSTR_RE.match(line)
+        by_name[name] = (opcode, m.group(2) if m else "", line)
+    converts = []
+    for name, opcode, line in instrs:
+        if opcode != "convert":
+            continue
+        dst = _result_dtype(by_name[name][1])
+        if dst not in _FLOAT_DST_DTYPES:
+            continue
+        paren = line[line.index("(") + 1:]
+        src_dtypes = [
+            _result_dtype(by_name[ref][1])
+            for ref in _OPERAND_RE.findall(paren)
+            if ref in by_name
+        ]
+        if any(d in _QUANT_SRC_DTYPES for d in src_dtypes):
+            converts.append(name)
+    chains = []
+    if converts:
+        conv_set = set(converts)
+        for name, opcode, line in instrs:
+            if opcode != "multiply":
+                continue
+            paren = line[line.index("(") + 1:]
+            hits = [r for r in _OPERAND_RE.findall(paren) if r in conv_set]
+            chains.extend(f"{h}->{name}" for h in hits)
+    return converts, chains
 
 
 def _elementwise_chains(instrs) -> List[Dict]:

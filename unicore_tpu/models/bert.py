@@ -31,19 +31,29 @@ from unicore_tpu.modules.remat import resolve_remat_policy as _resolve_remat
 
 class BertLMHead(nn.Module):
     """Masked-LM head (reference model.py:170-194); the tied projection
-    weight is passed in via the parent's embed module."""
+    weight is passed in via the parent's embed module.
+
+    Quantized serving: the dense routes through QuantDense with the gelu
+    fused into its epilogue and ``quantize_output=True`` — the int8
+    activation feeds the LayerNorm directly (the dequant multiply fuses
+    into the norm's statistics pass, modules/layer_norm.py)."""
 
     embed_dim: int
     output_dim: int
     activation_fn: str = "gelu"
+    quantize: str = ""
 
     @nn.compact
     def __call__(self, features, embed_attend):
-        x = nn.Dense(
+        from unicore_tpu.quant.dense import QuantDense
+
+        x = QuantDense(
             self.embed_dim, name="dense", kernel_init=bert_init,
             dtype=features.dtype, param_dtype=jnp.float32,
+            quantize=self.quantize,
+            activation=self.activation_fn,
+            quantize_output=bool(self.quantize),
         )(features)
-        x = utils.get_activation_fn(self.activation_fn)(x)
         x = LayerNorm(self.embed_dim, name="layer_norm")(x)
         x = embed_attend(x)
         bias = self.param(
@@ -119,6 +129,10 @@ class BertModel(BaseUnicoreModel):
     # kernels, supports per-batch biases) — --seq-parallel-impl.
     use_ring: bool = False
     seq_impl: str = "ring"
+    # quantized serving ('int8'/'fp8'): the serve CLI clones the model
+    # with this set and serves the calibrate.prepare()d tree; '' is the
+    # training-precision path, bit-identical to before (docs/serving.md)
+    quantize: str = ""
 
     @classmethod
     def add_args(cls, parser):
@@ -256,12 +270,14 @@ class BertModel(BaseUnicoreModel):
             pipeline_microbatches=self.pipeline_microbatches,
             use_ring=self.use_ring,
             seq_impl=self.seq_impl,
+            quantize=self.quantize,
             name="sentence_encoder",
         )
         self.lm_head = BertLMHead(
             embed_dim=self.encoder_embed_dim,
             output_dim=self.vocab_size,
             activation_fn=self.activation_fn,
+            quantize=self.quantize,
             name="lm_head",
         )
         if self.num_classes > 0:

@@ -121,6 +121,18 @@ class LayerNorm(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros, (self.normalized_shape,), jnp.float32
         )
+        from unicore_tpu.quant import QTensor
+
+        if isinstance(x, QTensor):
+            # quantized serving: a QuantDense(quantize_output=True) site
+            # feeds its int8 output straight in; the dequant multiply is
+            # fused into the norm's fp32 statistics pass (ops/quant_norm.py)
+            from unicore_tpu.ops.quant_norm import quant_layer_norm
+
+            return quant_layer_norm(
+                x.values, x.scale, weight, bias, eps=self.eps,
+                out_dtype=jnp.float32,
+            )
         if _use_pallas(self.use_pallas, "LayerNorm", self.normalized_shape):
             from unicore_tpu.ops.fused_norm import fused_layer_norm
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from unicore_tpu.ops.softmax_dropout import softmax_dropout
+from unicore_tpu.quant.dense import QuantDense
 
 logger = logging.getLogger(__name__)
 
@@ -235,6 +236,45 @@ def _ulysses_ok(use_seq, return_attn, tgt_len, src_len, attn_bias,
     return mesh, bias4
 
 
+def _quant_attend(q, k, v, key_padding_mask, attn_bias, bsz, num_heads,
+                  tgt_len, src_len):
+    """Quantized attention-score path (int8 serving, eval only): Q and K
+    quantize to int8 per tensor, the score matmul accumulates int32, and
+    ``ops/quant_softmax_dropout`` consumes the quantized scores directly —
+    the dequant multiply is fused into the softmax row pass, so the fp32
+    score tensor is never materialized between the matmul and the softmax
+    (the fusion audit's ``dequant`` section regression-checks this)."""
+    from unicore_tpu.ops.quant_matmul import (
+        dynamic_act_scale, quantize_to_int8,
+    )
+    from unicore_tpu.ops.quant_softmax_dropout import quant_softmax_dropout
+
+    q_scale = dynamic_act_scale(q)
+    k_scale = dynamic_act_scale(k)
+    q_q = quantize_to_int8(q, q_scale)
+    k_q = quantize_to_int8(k, k_scale)
+    scores_q = jax.lax.dot_general(
+        q_q, k_q,
+        dimension_numbers=(((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.int32,
+    )  # (B, H, Lq, Lk) int32
+    mask_add = None
+    if key_padding_mask is not None:
+        # additive form of the fp path's where(mask, finfo.min): dequantized
+        # scores are bounded far below fp32 max, so the sum stays finite and
+        # a fully-masked row degrades to the same uniform softmax
+        mask_add = (
+            key_padding_mask[:, None, None, :].astype(jnp.float32)
+            * jnp.finfo(jnp.float32).min
+        )
+    bias4 = _bias_to_bhll(attn_bias, bsz, num_heads, tgt_len, src_len)
+    probs = quant_softmax_dropout(
+        scores_q, q_scale * k_scale, 0.0, is_training=False,
+        mask=mask_add, bias=bias4, out_dtype=v.dtype,
+    )
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
 def _attend(
     module,
     q, k, v,
@@ -246,9 +286,10 @@ def _attend(
     use_flash,
     use_ring=False,
     seq_impl="ring",
+    quantize="",
 ):
-    """Shared core: pick seq-parallel (ring or all-to-all) vs flash vs
-    fused-softmax."""
+    """Shared core: pick quantized-score (int8 serving) vs seq-parallel
+    (ring or all-to-all) vs flash vs fused-softmax."""
     bsz, num_heads, tgt_len, head_dim = q.shape
     src_len = k.shape[2]
 
@@ -256,6 +297,16 @@ def _attend(
         key_padding_mask = None
 
     eff_dropout = dropout_rate if train else 0.0
+
+    if quantize == "int8" and not train and not return_attn:
+        # the quantized serving program takes the SAME path on every
+        # backend so the fusion audit checks the program that serves
+        # (fp8 quantizes the dense weights only — scores stay fp32)
+        o = _quant_attend(
+            q, k, v, key_padding_mask, attn_bias, bsz, num_heads,
+            tgt_len, src_len,
+        )
+        return o, None, None
 
     if use_ring and seq_impl == "ulysses":
         uly = _ulysses_ok(
@@ -408,6 +459,10 @@ class SelfMultiheadAttention(nn.Module):
     # this rank's query rows (H|1, Lc, L); key_padding_mask is the local
     # key chunk (B, Lc).
     seq_inside: bool = False
+    # '' (training precision), 'int8', or 'fp8': the projections route
+    # through QuantDense and (int8, eval) the score softmax consumes
+    # quantized scores (docs/serving.md "Quantized inference")
+    quantize: str = ""
 
     @nn.compact
     def __call__(
@@ -424,13 +479,14 @@ class SelfMultiheadAttention(nn.Module):
         assert head_dim * self.num_heads == embed_dim
         scaling = (head_dim * self.scaling_factor) ** -0.5
 
-        qkv = nn.Dense(
+        qkv = QuantDense(
             3 * embed_dim,
             use_bias=self.bias,
             name="in_proj",
             kernel_init=nn.initializers.normal(0.02),
             dtype=query.dtype,
             param_dtype=jnp.float32,
+            quantize=self.quantize,
         )(query)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = _split_heads(q, self.num_heads) * scaling
@@ -448,16 +504,18 @@ class SelfMultiheadAttention(nn.Module):
                 self.dropout, train, return_attn, self.use_flash,
                 use_ring=self.use_ring,
                 seq_impl=self.seq_impl,
+                quantize=self.quantize,
             )
 
         o = _merge_heads(o)
-        o = nn.Dense(
+        o = QuantDense(
             embed_dim,
             use_bias=self.bias,
             name="out_proj",
             kernel_init=nn.initializers.normal(0.02),
             dtype=query.dtype,
             param_dtype=jnp.float32,
+            quantize=self.quantize,
         )(o)
         if not return_attn:
             return o

@@ -24,7 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from unicore_tpu import utils
+from unicore_tpu.quant.dense import QuantDense
 from .layer_norm import LayerNorm
 from .multihead_attention import SelfMultiheadAttention
 
@@ -93,6 +93,9 @@ class TransformerEncoderLayer(nn.Module):
     # GPipe stage body): the attention runs ring collectives directly on
     # the local chunks (see SelfMultiheadAttention.seq_inside)
     seq_inside: bool = False
+    # quantized serving ('int8'/'fp8'): dense call sites route through
+    # QuantDense, '' is the training-precision path (bit-identical)
+    quantize: str = ""
 
     @nn.compact
     def __call__(
@@ -103,7 +106,6 @@ class TransformerEncoderLayer(nn.Module):
         return_attn: bool = False,
         train: bool = False,
     ):
-        act = utils.get_activation_fn(self.activation_fn)
         dropout = partial(
             nn.Dropout(rate=self.dropout), deterministic=not train
         )
@@ -122,6 +124,7 @@ class TransformerEncoderLayer(nn.Module):
             use_ring=self.use_ring,
             seq_impl=self.seq_impl,
             seq_inside=self.seq_inside,
+            quantize=self.quantize,
             name="self_attn",
         )(
             x,
@@ -141,21 +144,25 @@ class TransformerEncoderLayer(nn.Module):
         ln_final = LayerNorm(self.embed_dim, name="final_layer_norm")
         if not self.post_ln:
             x = ln_final(x)
-        x = nn.Dense(
+        # activation fused into fc1's epilogue: identical composition on
+        # the fp path, one in-VMEM nonlinearity on the quantized path
+        x = QuantDense(
             self.ffn_embed_dim,
             name="fc1",
             kernel_init=bert_init,
             dtype=x.dtype,
             param_dtype=jnp.float32,
+            quantize=self.quantize,
+            activation=self.activation_fn,
         )(x)
-        x = act(x)
         x = act_dropout(x)
-        x = nn.Dense(
+        x = QuantDense(
             self.embed_dim,
             name="fc2",
             kernel_init=bert_init,
             dtype=x.dtype,
             param_dtype=jnp.float32,
+            quantize=self.quantize,
         )(x)
         x = dropout(x)
         x = residual + x
@@ -207,6 +214,9 @@ class TransformerEncoder(nn.Module):
     # batch % pipeline_microbatches == 0.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 4
+    # quantized serving ('int8'/'fp8', docs/serving.md): every layer's
+    # dense call sites route through QuantDense; '' = training precision
+    quantize: str = ""
 
     def setup(self):
         self.emb_layer_norm = LayerNorm(self.embed_dim, name="emb_layer_norm")
@@ -216,6 +226,12 @@ class TransformerEncoder(nn.Module):
         layer_cls = TransformerEncoderLayer
         moe_cls = None
         if self.moe_experts > 0:
+            if self.quantize:
+                raise ValueError(
+                    "quantized serving does not support the MoE FFN yet "
+                    "(routed expert denses are not QuantDense sites); "
+                    "serve this checkpoint with --serve-quantize off"
+                )
             from .moe import MoEEncoderLayer
 
             moe_cls = MoEEncoderLayer
@@ -241,6 +257,9 @@ class TransformerEncoder(nn.Module):
                 seq_impl=self.seq_impl,
                 name=f"layers_{i}",
             )
+            if moe_cls is None:
+                # MoEEncoderLayer has no quantize attr (guarded above)
+                common["quantize"] = self.quantize
             # every moe_every-th layer (starting at moe_every - 1, so layer 0
             # stays dense — the common interleaved-MoE recipe)
             if moe_cls is not None and i % self.moe_every == self.moe_every - 1:
@@ -257,6 +276,10 @@ class TransformerEncoder(nn.Module):
             # stacked per-layer params for the GPipe schedule: leading dim
             # num_layers, sharded over 'pipe' by DEFAULT_PP_RULES
             assert self.moe_experts == 0, "MoE inside the pipeline: unsupported"
+            assert not self.quantize, (
+                "quantized serving inside the pipeline: unsupported "
+                "(the single-process serving plane never pipelines)"
+            )
             assert not (self.use_ring and self.seq_impl != "ring"), (
                 "only the ring seq-parallel impl composes with the "
                 "pipeline (its collectives run directly inside the stage "

@@ -1,22 +1,64 @@
 """Shared Pallas plumbing: the interpret-mode switch used by every kernel
 in ops/ (interpret=True runs kernels on any backend, e.g. the CPU test
-platform; env: UNICORE_TPU_PALLAS_INTERPRET=1)."""
+platform; env: UNICORE_TPU_PALLAS_INTERPRET=1).
+
+The gate resolves LAZILY per call, same discipline as the mode gates in
+``softmax_dropout.py``: an env var set AFTER this module imported still
+takes effect (tests and CLI subprocesses routinely import ops/ before
+deciding on interpret mode — an import-time read silently ignored them).
+An explicit :func:`set_interpret` call overrides the env either way;
+``set_interpret(None)`` returns control to the env var.
+"""
 
 import os
+from typing import Optional
 
 from jax.experimental import pallas as pl
 
-_INTERPRET = os.environ.get("UNICORE_TPU_PALLAS_INTERPRET", "0") == "1"
+#: explicit override; None = follow UNICORE_TPU_PALLAS_INTERPRET
+_override: Optional[bool] = None
 
 
-def set_interpret(enabled: bool):
-    global _INTERPRET
-    _INTERPRET = enabled
+def set_interpret(enabled: Optional[bool]):
+    global _override
+    _override = None if enabled is None else bool(enabled)
 
 
 def interpret_enabled() -> bool:
-    return _INTERPRET
+    if _override is not None:
+        return _override
+    return os.environ.get("UNICORE_TPU_PALLAS_INTERPRET", "0") == "1"
 
 
 def pallas_call(*args, **kwargs):
-    return pl.pallas_call(*args, interpret=_INTERPRET, **kwargs)
+    return pl.pallas_call(*args, interpret=interpret_enabled(), **kwargs)
+
+
+class ModeGate:
+    """One ``auto``/``on``/``off`` dispatch gate (the ``softmax_dropout.py``
+    pattern), shared by every gated kernel in ops/ so the resolution
+    discipline can't drift between copies.  Resolved LAZILY per call:
+    env var > setter > ``auto``; non-mode env values coerce to on/off
+    (``0``/``false``/empty = off, anything else = on)."""
+
+    MODES = ("auto", "on", "off")
+
+    def __init__(self, name: str, env_var: str):
+        self.name = name
+        self.env_var = env_var
+        self._mode: Optional[str] = None
+
+    def set(self, mode: Optional[str]) -> None:
+        if mode is not None and mode not in self.MODES:
+            raise ValueError(
+                f"{self.name} mode {mode!r} not in {self.MODES}"
+            )
+        self._mode = mode
+
+    def resolved(self) -> str:
+        env = os.environ.get(self.env_var)
+        if env is not None:
+            if env in self.MODES:
+                return env
+            return "off" if env in ("0", "false", "") else "on"
+        return self._mode or "auto"

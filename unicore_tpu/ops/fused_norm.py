@@ -53,9 +53,14 @@ def _pad_rows(x2):
 # forward
 # ---------------------------------------------------------------------------
 
-def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps, rms):
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps,
+                   rms, scale_ref=None):
     # mean_ref/rstd_ref are None on the forward-only (inference) path
     x = x_ref[...].astype(jnp.float32)  # (BN, D)
+    if scale_ref is not None:
+        # quantized-input variant: x is int8, dequant is ONE fused
+        # per-channel multiply on the fp32 rows (never a separate tensor)
+        x = x * scale_ref[...].astype(jnp.float32)
     if rms:
         mean = jnp.zeros((x.shape[0], 1), jnp.float32)
         var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
@@ -73,7 +78,7 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps, rms):
         rstd_ref[...] = rstd
 
 
-def _ln_fwd(x2, w, b, eps, rms, want_stats=True):
+def _ln_fwd(x2, w, b, eps, rms, want_stats=True, scale=None, out_dtype=None):
     N, D = x2.shape
     BN = _pick_rows(N)
     grid = (N // BN,)
@@ -85,20 +90,27 @@ def _ln_fwd(x2, w, b, eps, rms, want_stats=True):
     if b is not None:
         in_specs.append(pl.BlockSpec((1, D), lambda i: (0, 0)))
         inputs.append(b.reshape(1, D))
+    if scale is not None:
+        in_specs.append(pl.BlockSpec((1, D), lambda i: (0, 0)))
+        inputs.append(scale.reshape(1, D))
 
     def wrapped(*refs):
         n_out = 3 if want_stats else 1
         in_refs = refs[: len(inputs)]
         outs = refs[len(inputs): len(inputs) + n_out]
         x_ref, w_ref = in_refs[0], in_refs[1]
-        b_ref = in_refs[2] if b is not None else None
+        i = 2
+        b_ref = in_refs[i] if b is not None else None
+        i += int(b is not None)
+        s_ref = in_refs[i] if scale is not None else None
         y_ref = outs[0]
         m_ref = outs[1] if want_stats else None
         r_ref = outs[2] if want_stats else None
-        _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, m_ref, r_ref, eps=eps, rms=rms)
+        _ln_fwd_kernel(x_ref, w_ref, b_ref, y_ref, m_ref, r_ref, eps=eps,
+                       rms=rms, scale_ref=s_ref)
 
     out_specs = [pl.BlockSpec((BN, D), lambda i: (i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((N, D), x2.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((N, D), out_dtype or x2.dtype)]
     if want_stats:
         out_specs += [
             pl.BlockSpec((BN, 1), lambda i: (i, 0)),
@@ -264,3 +276,23 @@ def fused_layer_norm(x, weight, bias, eps: float = 1e-5):
 def fused_rms_norm(x, weight, eps: float = 1e-6):
     """Fused RMSNorm over the last dim: y = x * rsqrt(mean(x^2)) * w."""
     return _fused_norm(x, weight, None, eps, True)
+
+
+def quant_layer_norm_pallas(x_q, x_scale, weight, bias, eps: float = 1e-5,
+                            out_dtype=jnp.float32):
+    """Quantized-input LayerNorm: ``x_q`` int8, ``x_scale`` its dequant
+    factor (scalar or per-channel ``(D,)``); the dequant multiply is
+    fused into the row-statistics pass.  Forward-only (the serving
+    plane's eval path; no VJP for a quantized input)."""
+    shape = x_q.shape
+    D = shape[-1]
+    x2 = x_q.reshape(-1, D)
+    if x2.shape[0] == 0:
+        return jnp.zeros(shape, out_dtype)
+    x2, n = _pad_rows(x2)
+    scale = jnp.broadcast_to(
+        jnp.asarray(x_scale, jnp.float32).reshape(-1), (D,)
+    )
+    y, _, _ = _ln_fwd(x2, weight, bias, eps, False, want_stats=False,
+                      scale=scale, out_dtype=out_dtype)
+    return y[:n].reshape(shape)

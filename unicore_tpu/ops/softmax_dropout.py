@@ -35,31 +35,22 @@ This op is the API for modules that need materialized probabilities
 bound long-sequence cases are covered by the Pallas flash-attention kernel.
 """
 
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-_MODES = ("auto", "on", "off")
-_mode = None  # resolved lazily: env var > set_softmax_dropout_mode > auto
+from ._pallas import ModeGate
+
+_gate = ModeGate("softmax_dropout", "UNICORE_TPU_PALLAS_SOFTMAX_DROPOUT")
 
 
 def set_softmax_dropout_mode(mode: Optional[str]):
     """Select the dispatch mode (``auto``/``on``/``off``; None = auto)."""
-    global _mode
-    if mode is not None and mode not in _MODES:
-        raise ValueError(f"softmax_dropout mode {mode!r} not in {_MODES}")
-    _mode = mode
+    _gate.set(mode)
 
 
-def _resolved_mode() -> str:
-    env = os.environ.get("UNICORE_TPU_PALLAS_SOFTMAX_DROPOUT")
-    if env is not None:
-        if env in _MODES:
-            return env
-        return "off" if env in ("0", "false", "") else "on"
-    return _mode or "auto"
+_resolved_mode = _gate.resolved
 
 
 def _broadcastable_to(shape, target):

@@ -201,10 +201,16 @@ def _keep_mask(seed_ref, r, im, shape, rate, use_hw):
 # kernels
 # ---------------------------------------------------------------------------
 
-def _row_probs(x_ref, mask_ref, bias_ref):
+def _row_probs(x_ref, mask_ref, bias_ref, scale_ref=None):
     """fp32 softmax over the last dim, shared by fwd and bwd so the
-    recomputed probabilities are bit-identical to the applied ones."""
+    recomputed probabilities are bit-identical to the applied ones.
+
+    ``scale_ref`` (quantized-input variant): the input block is an int8
+    or int32 quantized tensor; dequantization is ONE fused multiply on
+    the fp32 row — never a separately materialized fp32 tensor."""
     x = x_ref[0].astype(jnp.float32)
+    if scale_ref is not None:
+        x = x * scale_ref[0]
     if mask_ref is not None:
         x = x + mask_ref[0].astype(jnp.float32)
     if bias_ref is not None:
@@ -214,8 +220,9 @@ def _row_probs(x_ref, mask_ref, bias_ref):
     return e / jnp.sum(e, axis=-1, keepdims=True)
 
 
-def _fwd_kernel(seed_ref, x_ref, mask_ref, bias_ref, o_ref, *, rate, use_hw):
-    p = _row_probs(x_ref, mask_ref, bias_ref)
+def _fwd_kernel(seed_ref, x_ref, mask_ref, bias_ref, o_ref, *, rate, use_hw,
+                scale_ref=None):
+    p = _row_probs(x_ref, mask_ref, bias_ref, scale_ref)
     y = p.astype(o_ref.dtype)
     if rate > 0.0:
         r, im = pl.program_id(0), pl.program_id(1)
@@ -225,8 +232,8 @@ def _fwd_kernel(seed_ref, x_ref, mask_ref, bias_ref, o_ref, *, rate, use_hw):
 
 
 def _bwd_kernel(seed_ref, x_ref, mask_ref, bias_ref, do_ref, ds_ref, *,
-                rate, use_hw):
-    p = _row_probs(x_ref, mask_ref, bias_ref)
+                rate, use_hw, scale_ref=None):
+    p = _row_probs(x_ref, mask_ref, bias_ref, scale_ref)
     dy = do_ref[0].astype(jnp.float32)
     if rate > 0.0:
         r, im = pl.program_id(0), pl.program_id(1)
@@ -245,7 +252,7 @@ def _bwd_kernel(seed_ref, x_ref, mask_ref, bias_ref, do_ref, ds_ref, *,
 # ---------------------------------------------------------------------------
 
 def _run(kernel, ishape, x3, plans, extras, seed, out_dtype, rate, use_hw,
-         extra_in=None):
+         extra_in=None, scale3=None):
     M, L = ishape[-2], ishape[-1]
     R = x3.shape[0]
     BM = _pick_rows(M, max(8, _MAX_BLOCK_ELEMS // max(L, 1)))
@@ -266,12 +273,16 @@ def _run(kernel, ishape, x3, plans, extras, seed, out_dtype, rate, use_hw,
             )
         )
         inputs.append(x)
+    if scale3 is not None:  # quantized-input dequant scale, one scalar
+        in_specs.append(pl.BlockSpec((1, 1, 1), lambda r, im, *_: (0, 0, 0)))
+        inputs.append(scale3)
     if extra_in is not None:  # the backward's incoming cotangent
         in_specs.append(pl.BlockSpec((1, BM, L), lambda r, im, *_: (r, im, 0)))
         inputs.append(extra_in)
 
     has_mask = extras[0] is not None
     has_bias = extras[1] is not None
+    has_scale = scale3 is not None
 
     def wrapped(seed_ref, *refs):
         x_ref = refs[0]
@@ -280,14 +291,16 @@ def _run(kernel, ishape, x3, plans, extras, seed, out_dtype, rate, use_hw,
         i += int(has_mask)
         bias_ref = refs[i] if has_bias else None
         i += int(has_bias)
+        scale_ref = refs[i] if has_scale else None
+        i += int(has_scale)
         if extra_in is not None:
             do_ref = refs[i]
             i += 1
             kernel(seed_ref, x_ref, mask_ref, bias_ref, do_ref, refs[i],
-                   rate=rate, use_hw=use_hw)
+                   rate=rate, use_hw=use_hw, scale_ref=scale_ref)
         else:
             kernel(seed_ref, x_ref, mask_ref, bias_ref, refs[i],
-                   rate=rate, use_hw=use_hw)
+                   rate=rate, use_hw=use_hw, scale_ref=scale_ref)
 
     out = _pallas_call(
         wrapped,
@@ -372,6 +385,34 @@ def pallas_plan(input_shape, input_dtype, mask, bias) -> Optional[tuple]:
     return tuple(plans)
 
 
+def _dispatch_prep(name, input, plan_dtype, mask, bias, plans,
+                   dropout_prob, is_training, seed):
+    """The shared dispatch body of the fp and quantized entry points:
+    plan resolution, row-geometry flattening, extras prep, seed shaping —
+    ONE copy so a future plan/layout change cannot skew the quantized
+    path's geometry handling from the fp path's."""
+    ishape = tuple(input.shape)
+    if plans is None:
+        plans = pallas_plan(ishape, plan_dtype, mask, bias)
+    if plans is None:
+        raise ValueError(
+            f"{name} cannot express input {ishape} {plan_dtype} with mask "
+            f"{None if mask is None else mask.shape} / bias "
+            f"{None if bias is None else bias.shape}; use the jnp path"
+        )
+    M, L = ishape[-2], ishape[-1]
+    R = 1
+    for d in ishape[:-2]:
+        R *= d
+    rate = float(dropout_prob) if is_training else 0.0
+    use_hw = not interpret_enabled()
+    x3 = input.reshape(R, M, L)
+    mask3 = _extra_3d(mask, plans[0], ishape) if mask is not None else None
+    bias3 = _extra_3d(bias, plans[1], ishape) if bias is not None else None
+    seed = jnp.reshape(jnp.asarray(seed, dtype=jnp.int32), (1,))
+    return plans, ishape, x3, mask3, bias3, seed, rate, use_hw
+
+
 def softmax_dropout_pallas(
     input: jnp.ndarray,
     dropout_prob: float,
@@ -389,30 +430,36 @@ def softmax_dropout_pallas(
     ``jax.random.bernoulli``, so masks are not comparable across paths —
     rate, scaling, determinism, and gradients are (tests prove all four).
     """
-    if plans is None:
-        plans = pallas_plan(input.shape, input.dtype, mask, bias)
-    if plans is None:
-        raise ValueError(
-            f"softmax_dropout_pallas cannot express input {input.shape} "
-            f"{input.dtype} with mask "
-            f"{None if mask is None else mask.shape} / bias "
-            f"{None if bias is None else bias.shape}; use the jnp path"
-        )
-    ishape = tuple(input.shape)
-    M, L = ishape[-2], ishape[-1]
-    R = 1
-    for d in ishape[:-2]:
-        R *= d
-    rate = float(dropout_prob) if is_training else 0.0
-    use_hw = not interpret_enabled()
-
-    x3 = input.reshape(R, M, L)
-    mask3 = bias3 = None
-    if mask is not None:
-        mask3 = _extra_3d(mask, plans[0], ishape)
-    if bias is not None:
-        bias3 = _extra_3d(bias, plans[1], ishape)
-    seed = jnp.reshape(jnp.asarray(seed, dtype=jnp.int32), (1,))
+    plans, ishape, x3, mask3, bias3, seed, rate, use_hw = _dispatch_prep(
+        "softmax_dropout_pallas", input, input.dtype, mask, bias, plans,
+        dropout_prob, is_training, seed,
+    )
     cfg = (plans, ishape, use_hw)
     out = _sd(x3, mask3, bias3, seed, rate, cfg)
+    return out.reshape(ishape)
+
+
+def quant_softmax_dropout_pallas(
+    input_q: jnp.ndarray,
+    x_scale,
+    dropout_prob: float,
+    is_training: bool = False,
+    mask: Optional[jnp.ndarray] = None,
+    bias: Optional[jnp.ndarray] = None,
+    seed=0,
+    plans: Optional[tuple] = None,
+    out_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Quantized-input variant: ``input_q`` is an int8 (or int32
+    accumulator) tensor and ``x_scale`` its scalar dequant factor; the
+    dequant multiply is fused into the row softmax pass — the fp32 logits
+    never exist as a tensor.  Forward-only (the serving plane's eval
+    path; no VJP is defined for a quantized input)."""
+    plans, ishape, x3, mask3, bias3, seed, rate, use_hw = _dispatch_prep(
+        "quant_softmax_dropout_pallas", input_q, jnp.float32, mask, bias,
+        plans, dropout_prob, is_training, seed,
+    )
+    scale3 = jnp.reshape(jnp.asarray(x_scale, jnp.float32), (1, 1, 1))
+    out = _run(_fwd_kernel, ishape, x3, plans, (mask3, bias3), seed,
+               out_dtype, rate, use_hw, scale3=scale3)
     return out.reshape(ishape)

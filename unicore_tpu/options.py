@@ -162,6 +162,33 @@ def add_serving_args(parser):
                             "boundary, rolling back (and continuing to "
                             "serve the old snapshot) if verification or "
                             "the probe batch fails (0 disables)")
+    group.add_argument("--serve-quantize", default="off",
+                       choices=["off", "int8", "fp8"],
+                       help="post-training quantized inference "
+                            "(docs/serving.md 'Quantized inference'): a "
+                            "startup calibration pass runs deterministic "
+                            "held-out batches through the warmed bucket "
+                            "geometries, captures per-channel weight + "
+                            "per-site activation scales (persisted beside "
+                            "the checkpoint, digest-tied to its weights), "
+                            "and serves the int8 (or fp8-weight) programs "
+                            "with dequant fused into the consuming ops; "
+                            "hot reload re-verifies or re-derives scales "
+                            "before any swap and rolls back "
+                            "'rejected:calibration' on failure")
+    group.add_argument("--calibration-batches", type=int, default=1,
+                       metavar="N",
+                       help="calibration rounds per bucket edge (more "
+                            "rounds widen the observed activation range; "
+                            "scales stay a pure function of the weights "
+                            "and the fixed-seed stream)")
+    group.add_argument("--quant-drift-sample", type=int, default=64,
+                       metavar="N",
+                       help="with --serve-quantize: every N-th dispatched "
+                            "batch is re-run through the full-precision "
+                            "oracle and the per-request max |logit drift| "
+                            "lands in /stats and the 'quant-path' journal "
+                            "kind (0 disables the shadow check)")
     group.add_argument("--serve-max-seconds", type=float, default=0.0,
                        metavar="SECS",
                        help="auto-drain and exit after this long "

@@ -1,0 +1,172 @@
+"""``QuantDense`` — the ONE quantize-aware dense entry point every wired
+call site routes through (``modules/multihead_attention.py``,
+``modules/transformer_encoder.py``, ``models/bert.py``).
+
+Three behaviors behind one module, selected by the ``quantize`` attr and
+the trace-time calibration flag:
+
+- **fp32/bf16 path** (``quantize == ''`` or inside
+  :func:`~unicore_tpu.quant.calibration_scope`): byte-for-byte the
+  ``nn.Dense`` computation (same param names, same ``promote_dtype`` +
+  ``lax.dot_general``), optionally followed by the module's fused
+  ``activation`` — training and non-quantized serving are untouched;
+- **calibration** (fp32 path inside the scope): additionally sows the
+  per-site input absmax (and post-activation output absmax for
+  ``quantize_output`` sites) into the ``quant_calib`` collection with a
+  running-max reducer — ``calibrate.collect_scales`` reads them;
+- **quantized path** (``quantize in ('int8', 'fp8')``, not calibrating):
+  reads the PREPARED params (``kernel_q``/``kernel_scale``/``act_scale``
+  [+ ``out_scale``], built by ``calibrate.prepare`` from the fp32
+  checkpoint + calibrated scales), quantizes the incoming activation with
+  the calibrated static scale, and runs ``ops/quant_matmul.py`` with
+  dequant + bias + activation fused into the epilogue.  With
+  ``quantize_output`` the result is re-quantized against the calibrated
+  output scale and returned as a :class:`~unicore_tpu.quant.QTensor` for
+  a quantized-input consumer (``ops/quant_norm.py``).
+
+The quantized path is inference-only: no VJP, dropout-free call sites.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen.dtypes import promote_dtype
+
+from unicore_tpu import quant as _q
+
+#: the mutable collection calibration sows into
+CALIB_COLLECTION = "quant_calib"
+
+
+def _running_max(acc, new):
+    return jnp.maximum(acc, new)
+
+
+def _absmax(x) -> jnp.ndarray:
+    return jnp.max(jnp.abs(x.astype(jnp.float32)))
+
+
+class QuantDense(nn.Dense):
+    """Drop-in ``nn.Dense`` with a quantized serving path.
+
+    Extra attrs on top of ``nn.Dense``:
+
+    - ``quantize``: '' (fp32/bf16, the default — training checkpoints and
+      numerics are bit-identical to ``nn.Dense``), 'int8', or 'fp8';
+    - ``activation``: optional fused epilogue nonlinearity (the
+      ``utils.get_activation_fn`` name table); applied on BOTH paths so
+      the composition is identical;
+    - ``quantize_output``: re-quantize the (post-activation) output with
+      the calibrated ``out_scale`` and return a ``QTensor``.
+    """
+
+    quantize: str = ""
+    activation: str = ""
+    quantize_output: bool = False
+
+    @nn.compact
+    def __call__(self, inputs):  # noqa: C901 — three documented paths
+        # check_mode treats '' and 'off' the same (and rejects typos
+        # loudly at trace time) — a plumbed-through --serve-quantize
+        # default of 'off' must take the fp path, not KeyError
+        if _q.check_mode(self.quantize) != "off" and not _q.calibrating():
+            return self._quantized(inputs)
+
+        # -- the nn.Dense computation, replicated byte-for-byte ----------
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (jnp.shape(inputs)[-1], self.features),
+            self.param_dtype,
+        )
+        bias = (
+            self.param("bias", self.bias_init, (self.features,),
+                       self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        x, kernel, bias = promote_dtype(inputs, kernel, bias,
+                                        dtype=self.dtype)
+        if _q.calibrating():
+            self.sow(CALIB_COLLECTION, "act_absmax", _absmax(x),
+                     init_fn=lambda: jnp.float32(0.0),
+                     reduce_fn=_running_max)
+        y = jax.lax.dot_general(
+            x, kernel,
+            (((x.ndim - 1,), (0,)), ((), ())),
+            precision=self.precision,
+        )
+        if bias is not None:
+            y += jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        if self.activation:
+            from unicore_tpu.utils import get_activation_fn
+
+            y = get_activation_fn(self.activation)(y)
+        if _q.calibrating() and self.quantize_output:
+            self.sow(CALIB_COLLECTION, "out_absmax", _absmax(y),
+                     init_fn=lambda: jnp.float32(0.0),
+                     reduce_fn=_running_max)
+        return y
+
+    # -- quantized serving path ------------------------------------------
+
+    def _quantized(self, inputs):
+        from unicore_tpu.ops.quant_matmul import quant_matmul
+
+        mode = _q.check_mode(self.quantize)
+        qmax = _q.QMAX[mode]
+        in_dim = jnp.shape(inputs)[-1]
+        # prepared params (calibrate.prepare) — init_fns exist only so a
+        # stray init() fails loudly with sane shapes instead of cryptically
+        kernel_q = self.param(
+            "kernel_q", nn.initializers.zeros,
+            (in_dim, self.features), _storage_dtype(mode),
+        )
+        kernel_scale = self.param(
+            "kernel_scale", nn.initializers.ones, (self.features,),
+            jnp.float32,
+        )
+        act_scale = self.param(
+            "act_scale", nn.initializers.ones, (), jnp.float32
+        )
+        bias = (
+            self.param("bias", self.bias_init, (self.features,),
+                       self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        x_q = _quantize(inputs, act_scale, qmax, _storage_dtype(mode))
+        out_dtype = self.dtype or jnp.asarray(inputs).dtype
+        y = quant_matmul(
+            x_q, kernel_q,
+            scale=act_scale * kernel_scale,
+            bias=bias,
+            activation=self.activation,
+            out_dtype=out_dtype,
+        )
+        if self.quantize_output:
+            out_scale = self.param(
+                "out_scale", nn.initializers.ones, (), jnp.float32
+            )
+            return _q.QTensor(
+                _quantize(y, out_scale, qmax, _storage_dtype(mode)),
+                out_scale,
+            )
+        return y
+
+
+def _storage_dtype(mode: str):
+    if mode == "int8":
+        return jnp.int8
+    return jnp.float8_e4m3fn
+
+
+def _quantize(x, scale, qmax: float, dtype):
+    """Symmetric quantization against a calibrated static scale — the
+    shared ``quantize_to_dtype`` step, so QuantDense and the kernel
+    oracles quantize identically by construction."""
+    from unicore_tpu.ops.quant_matmul import quantize_to_dtype
+
+    return quantize_to_dtype(x, scale, qmax, dtype)

@@ -11,9 +11,13 @@ three gates, in order:
    (``draining`` / ``not-ready``);
 2. capacity: a full queue sheds ``queue-full``;
 3. deadline feasibility: a request whose deadline cannot survive the
-   ESTIMATED queue delay (queue depth / batch capacity x the engine's
-   EMA batch-service time) sheds ``deadline-unmeetable`` — rejecting at
+   ESTIMATED queue delay sheds ``deadline-unmeetable`` — rejecting at
    admission is strictly kinder than computing a response nobody can use.
+   The estimate is per-bucket: queued work groups by shape bucket and
+   each bucket's batches are costed at that (bucket, precision) program's
+   OWN service-time EMA (a seq-32 int8 batch and a seq-512 bf16 batch
+   differ by orders of magnitude; one global EMA misestimates both).
+   A bucket with no sample yet falls back to the global EMA.
 
 Deadlines are enforced again at batch formation (:meth:`take_batch` drops
 expired requests from a forming batch — they are never computed) and a
@@ -45,19 +49,38 @@ class AdmissionQueue:
     batch formation."""
 
     def __init__(self, capacity: int, *, batch_capacity: int = 8,
-                 max_len: int = 0, service_ema_alpha: float = 0.2):
+                 max_len: int = 0, service_ema_alpha: float = 0.2,
+                 bucket_edges: Optional[Sequence[int]] = None,
+                 precision: str = ""):
         self.capacity = int(capacity)
         self.batch_capacity = max(1, int(batch_capacity))
         #: longest admissible request (0 = unchecked); anything longer can
         #: never fit a warmed program and sheds at the door
         self.max_len = int(max_len)
         self._alpha = float(service_ema_alpha)
+        #: bucket set for per-bucket service estimation (None = the
+        #: pre-bucketed behavior: one global EMA)
+        self.bucket_edges = (
+            tuple(sorted(int(e) for e in bucket_edges))
+            if bucket_edges else None
+        )
+        #: precision label ('bf16'/'int8'/'fp8'/...) keying the per-bucket
+        #: EMAs: a seq-32 int8 batch and a seq-512 bf16 batch are nothing
+        #: alike, and one global EMA misestimates both (docs/serving.md)
+        self.precision = str(precision)
         self._items: deque = deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         #: EMA of one batch's service time (seconds); None until the
-        #: engine has dispatched a batch (warm-up seeds it)
+        #: engine has dispatched a batch (warm-up seeds it).  The global
+        #: EMA stays the fallback for buckets without a sample yet.
         self._service_ema: Optional[float] = None
+        #: (bucket, precision) -> EMA of that program's batch-service time
+        self._service_ema_by_key = {}
+        #: bucket -> queued-item count, maintained incrementally on
+        #: offer/take so the admission gate's delay estimate stays O(1)
+        #: in queue depth (a flood admits against a full queue)
+        self._bucket_counts: dict = {}
         self._accepting = False
         self._draining = False
         # batches popped but not yet fully responded (engine calls
@@ -106,30 +129,97 @@ class AdmissionQueue:
 
     # -- service-time feedback (engine) ----------------------------------
 
-    def note_batch_service(self, seconds: float) -> None:
+    def note_batch_service(self, seconds: float,
+                           bucket: Optional[int] = None) -> None:
         """EMA update from the engine after each dispatched batch; also
-        seeded once by warm-up so the very first estimates aren't blind."""
+        seeded once per bucket by warm-up so the very first estimates
+        aren't blind.  ``bucket`` keys the per-(bucket, precision) EMA —
+        without it only the global fallback updates."""
         seconds = float(seconds)
-        with self._lock:
-            self._service_ema = (
-                seconds
-                if self._service_ema is None
-                else self._alpha * seconds + (1 - self._alpha) * self._service_ema
+
+        def fold(prev):
+            return (
+                seconds if prev is None
+                else self._alpha * seconds + (1 - self._alpha) * prev
             )
 
-    def estimated_delay(self) -> float:
+        with self._lock:
+            self._service_ema = fold(self._service_ema)
+            if bucket is not None:
+                key = (int(bucket), self.precision)
+                self._service_ema_by_key[key] = fold(
+                    self._service_ema_by_key.get(key)
+                )
+
+    def _bucket_of(self, n: int) -> Optional[int]:
+        """The padded length request-length ``n`` snaps to (take_batch's
+        rule); None when the queue was built without a bucket set."""
+        if self.bucket_edges is None:
+            return None
+        return bucket_for(n, self.bucket_edges) or min(
+            max(n, 1), self.max_len or n
+        )
+
+    def _count_queued(self, req, delta: int) -> None:
+        """Incremental per-bucket bookkeeping (caller holds the lock):
+        +1 on offer, -1 when an item PERMANENTLY leaves the deque (picked
+        or expired — items returned to the queue are a wash)."""
+        if self.bucket_edges is None:
+            return
+        b = self._bucket_of(len(req))
+        n = self._bucket_counts.get(b, 0) + delta
+        if n > 0:
+            self._bucket_counts[b] = n
+        else:
+            self._bucket_counts.pop(b, None)
+
+    def _ema_for(self, bucket: Optional[int]) -> Optional[float]:
+        if bucket is not None:
+            ema = self._service_ema_by_key.get((bucket, self.precision))
+            if ema is not None:
+                return ema
+        # a bucket no batch has timed yet estimates with the global EMA —
+        # blind-but-bounded beats shedding on a zero estimate
+        return self._service_ema
+
+    def estimated_delay(self, length: Optional[int] = None) -> float:
         """Seconds a request admitted NOW is expected to wait before its
         batch completes: queued batches ahead of it plus its own batch's
-        service time.  0.0 until the engine has calibrated."""
+        service time, each batch costed at ITS bucket's (bucket,
+        precision) service EMA.  0.0 until the engine has calibrated."""
         with self._lock:
-            return self._estimated_delay_locked(extra=1)
+            return self._estimated_delay_locked(extra_len=length)
 
-    def _estimated_delay_locked(self, extra: int = 1) -> float:
+    def _estimated_delay_locked(
+        self, extra: int = 1, extra_len: Optional[int] = None
+    ) -> float:
         if self._service_ema is None:
             return 0.0
-        batches_ahead = (len(self._items) + extra + self.batch_capacity - 1) \
-            // self.batch_capacity
-        return batches_ahead * self._service_ema
+        if self.bucket_edges is None:
+            batches_ahead = (len(self._items) + extra
+                             + self.batch_capacity - 1) \
+                // self.batch_capacity
+            return batches_ahead * self._service_ema
+        # per-bucket estimate: batch formation is bucket-affine, so the
+        # queue drains as ceil(count/capacity) batches PER bucket, each at
+        # that bucket's own service time — one global EMA overcharges
+        # short-seq requests behind long-seq ones (and vice versa)
+        counts = dict(self._bucket_counts)
+        if extra and extra_len is not None:
+            b = self._bucket_of(extra_len)
+            counts[b] = counts.get(b, 0) + extra
+        total = 0.0
+        for b, n in counts.items():
+            batches = (n + self.batch_capacity - 1) // self.batch_capacity
+            ema = self._ema_for(b)
+            total += batches * (ema if ema is not None else 0.0)
+        if extra and extra_len is None:
+            # no length known (the /stats observability path): cost the
+            # hypothetical request one batch at the BLENDED global EMA —
+            # pinning it to the largest bucket would report worst-case
+            # delay on an empty queue
+            total += self._service_ema
+        return total
 
     # -- admission -------------------------------------------------------
 
@@ -156,16 +246,21 @@ class AdmissionQueue:
                 reason = rq.EXPIRED_AT_ADMISSION
             elif len(self._items) >= self.capacity:
                 reason = rq.SHED_QUEUE_FULL
-            elif req.deadline.remaining() < self._estimated_delay_locked():
+            elif req.deadline.remaining() < self._estimated_delay_locked(
+                extra_len=len(req)
+            ):
                 reason = rq.SHED_DEADLINE_UNMEETABLE
             else:
                 self._items.append(req)
+                self._count_queued(req, +1)
                 self.admitted += 1
                 self._cond.notify()
                 return True
             self._count_shed(reason)
             count = self.shed_counts[reason]
-            depth, est = len(self._items), self._estimated_delay_locked()
+            depth, est = len(self._items), self._estimated_delay_locked(
+                extra_len=len(req)
+            )
         # resolve OUTSIDE the lock: respond() wakes transport waiters
         if reason == rq.EXPIRED_AT_ADMISSION:
             req.expire(reason)
@@ -218,6 +313,7 @@ class AdmissionQueue:
                 head = None
                 while self._items:
                     cand = self._items.popleft()
+                    self._count_queued(cand, -1)
                     if cand.deadline.exceeded():
                         expired.append(cand)
                         continue
@@ -239,6 +335,7 @@ class AdmissionQueue:
                 keep: List[rq.ServeRequest] = []
                 while self._items and len(picked) < self.batch_capacity:
                     cand = self._items.popleft()
+                    self._count_queued(cand, -1)
                     if cand.deadline.exceeded():
                         expired.append(cand)
                         continue
@@ -251,6 +348,7 @@ class AdmissionQueue:
                         keep.append(cand)
                 for item in reversed(keep):
                     self._items.appendleft(item)
+                    self._count_queued(item, +1)
             if picked:
                 # same lock as the pop: an observer can never see the
                 # queue empty while these requests are un-responded

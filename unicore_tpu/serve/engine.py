@@ -105,6 +105,11 @@ class ServeEngine:
         admission_capacity: int = 256,
         cache_size_probe: Optional[Callable[[], int]] = None,
         latency_window: int = 2048,
+        precision: str = "",
+        quant_info: Optional[dict] = None,
+        drift_probe: Optional[Callable] = None,
+        drift_sample_every: int = 64,
+        swap_hook: Optional[Callable] = None,
     ):
         if not bucket_edges:
             raise ValueError("bucket_edges must name at least one length")
@@ -113,11 +118,33 @@ class ServeEngine:
         self.bucket_edges = tuple(sorted(int(e) for e in bucket_edges))
         self.batch_size = max(1, int(batch_size))
         self.pad_idx = int(pad_idx)
+        #: precision label for /stats and the admission queue's
+        #: per-(bucket, precision) service EMAs ('' = training precision)
+        self.precision = str(precision)
         self.queue = queue or AdmissionQueue(
             admission_capacity,
             batch_capacity=self.batch_size,
             max_len=self.bucket_edges[-1],
+            bucket_edges=self.bucket_edges,
+            precision=self.precision,
         )
+        #: calibration summary from quant.calibrate (mode, scale source,
+        #: site count, calibration drift bound) — surfaced in /stats
+        self.quant_info = quant_info
+        #: optional sampled per-request logit-drift probe (quantized
+        #: serving): tokens[B, L] -> per-row max |logit_q - logit_f32|.
+        #: Runs every ``drift_sample_every``-th batch — a bounded shadow
+        #: cost that keeps the error-bound contract observable in
+        #: production, not just at calibration time.
+        self._drift_probe = drift_probe
+        self._drift_every = max(0, int(drift_sample_every))
+        self._drift = {"samples": 0, "max_abs": 0.0, "mean_abs": 0.0,
+                       "last_abs": 0.0}
+        self._drift_probe_dead = False
+        #: called with (variables, tag) right after a hot swap applies —
+        #: the quantized CLI re-pairs its drift oracle here so sampled
+        #: drift always compares the snapshot actually serving
+        self._swap_hook = swap_hook
         self._cache_size_probe = cache_size_probe
         self._warm_programs = 0
         self.recompiles_after_warmup = 0
@@ -192,7 +219,8 @@ class ServeEngine:
             # real requests as deadline-unmeetable
             tb0 = time.monotonic()
             _block_on(self.infer_fn(self.variables, dummy))
-            self.queue.note_batch_service(time.monotonic() - tb0)
+            self.queue.note_batch_service(time.monotonic() - tb0,
+                                          bucket=edge)
         if self._cache_size_probe is not None:
             with self._lock:
                 self._warm_programs = self._cache_size_probe()
@@ -278,6 +306,11 @@ class ServeEngine:
         if pending is None:
             return
         self.variables = pending
+        if self._swap_hook is not None:
+            try:
+                self._swap_hook(pending, tag)
+            except Exception:
+                logger.exception("swap hook failed (swap stands)")
         self.reloads_applied += 1
         logger.warning(
             f"RELOAD SWAPPED: serving snapshot replaced on batch boundary "
@@ -343,7 +376,7 @@ class ServeEngine:
             ids, score = self.infer_fn(self.variables, arr)
             ids, score = np.asarray(ids), np.asarray(score)
             service = time.monotonic() - t0
-            self.queue.note_batch_service(service)
+            self.queue.note_batch_service(service, bucket=padded)
             self._batch_seq += 1
             for i, r in enumerate(reqs):
                 if r.deadline.exceeded():
@@ -369,10 +402,59 @@ class ServeEngine:
                     self._latencies_ms.append(latency_ms)
                     if len(self._latencies_ms) > self._latency_window:
                         del self._latencies_ms[: self._latency_window // 4]
+            self._maybe_sample_drift(arr, len(reqs))
             self._watch_recompiles()
             return len(reqs)
         finally:
             self.queue.batch_done()
+
+    def _maybe_sample_drift(self, arr, n_real: int) -> None:
+        """Sampled per-request logit-drift check (quantized serving):
+        every ``drift_sample_every``-th batch re-runs through the fp32
+        oracle and records max |logit_q - logit_f32| per REAL request row.
+        A dying probe disables itself — observability must never take the
+        serving loop down."""
+        if (
+            self._drift_probe is None
+            or self._drift_probe_dead
+            or self._drift_every <= 0
+            or self._batch_seq % self._drift_every != 0
+        ):
+            return
+        try:
+            per_row = np.asarray(self._drift_probe(arr), np.float32)
+        except Exception:
+            self._drift_probe_dead = True
+            logger.exception(
+                "quant drift probe died; per-request drift sampling "
+                "disabled (serving continues)"
+            )
+            return
+        rows = per_row[:n_real] if per_row.ndim else per_row.reshape(1)
+        if rows.size == 0:
+            return
+        batch_max = float(rows.max())
+        with self._lock:
+            d = self._drift
+            d["samples"] += int(n_real)
+            d["last_abs"] = batch_max
+            d["max_abs"] = max(d["max_abs"], batch_max)
+            # EMA so a long run's mean tracks the CURRENT snapshot, not
+            # every snapshot ever swapped in
+            mean = float(rows.mean())
+            d["mean_abs"] = (
+                mean if d["samples"] <= n_real
+                else 0.1 * mean + 0.9 * d["mean_abs"]
+            )
+            snapshot = dict(d)
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "quant-path", event="drift-sample", batch=int(self._batch_seq),
+            requests=int(n_real),
+            max_abs_logit_drift=round(batch_max, 6),
+            running_max=round(snapshot["max_abs"], 6),
+        )
 
     # -- drain / stop ----------------------------------------------------
 
@@ -459,10 +541,28 @@ class ServeEngine:
             for p in (50, 90, 99)
         }
 
+    def update_quant_info(self, info: dict) -> None:
+        """A hot swap committed a re-calibrated snapshot: /stats must
+        describe the snapshot actually SERVING, so the calibration block
+        is replaced and the per-request drift aggregate starts over —
+        a monotonic max spanning swaps would report a long-gone
+        snapshot's worst sample forever."""
+        with self._lock:
+            self.quant_info = dict(info)
+            self._drift = {"samples": 0, "max_abs": 0.0, "mean_abs": 0.0,
+                           "last_abs": 0.0}
+
     def stats(self) -> dict:
+        quant = None
+        if self.quant_info is not None:
+            with self._lock:
+                drift = dict(self._drift)
+                quant = {**self.quant_info, "request_drift": drift}
         return {
             "phase": self._phase,
             "ready": self._ready,
+            "precision": self.precision or "training",
+            **({"quant": quant} if quant is not None else {}),
             "served": self.served,
             "admitted": self.queue.admitted,
             "shed": dict(self.queue.shed_counts),

@@ -45,6 +45,7 @@ OUTCOME_SWAPPED = "swapped"
 OUTCOME_REJECTED_VERIFY = "rejected:verify"
 OUTCOME_REJECTED_STRUCTURE = "rejected:structure"
 OUTCOME_REJECTED_PROBE = "rejected:probe"
+OUTCOME_REJECTED_CALIBRATION = "rejected:calibration"
 
 
 class CheckpointWatcher:
@@ -74,17 +75,41 @@ class CheckpointWatcher:
 
 
 class HotReloader:
-    """verify → probe → swap-or-rollback for one candidate at a time."""
+    """verify → [calibrate] → probe → swap-or-rollback for one candidate
+    at a time.
+
+    Quantized serving adds the calibration stage: ``preparer`` (when set)
+    takes the candidate's fp32 model tree and returns the PREPARED
+    quantized tree — re-using the persisted scale file only when its
+    weights digest matches the candidate, re-deriving scales otherwise
+    (quant/calibrate.py).  Any failure there is a named
+    ``rejected:calibration`` rollback: the serving snapshot (and its
+    scales) keep serving, exactly like every other rejection.  The
+    structure check then runs against ``structure_ref`` — the fp32
+    reference tree — because the engine's live tree is the prepared one
+    (``kernel_q``/``kernel_scale`` leaves, a different structure than any
+    published checkpoint)."""
 
     def __init__(
         self,
         engine,
         loader: Callable[[str], dict],
         prober: Optional[Callable] = None,
+        preparer: Optional[Callable] = None,
+        preparer_abort: Optional[Callable] = None,
+        structure_ref=None,
     ):
         self.engine = engine
         self.loader = loader
         self.prober = prober if prober is not None else engine.probe
+        self.preparer = preparer
+        #: called when a candidate is rejected AFTER ``preparer``
+        #: succeeded (probe failure): whatever the preparer staged for
+        #: this candidate (device trees, drift-oracle pairs) must be
+        #: released — a rejected candidate's staging otherwise leaks
+        #: until the next reload, or worse, mispairs the drift oracle
+        self.preparer_abort = preparer_abort
+        self.structure_ref = structure_ref
         self.swapped = 0
         self.rolled_back = 0
         self.last_outcome: Optional[str] = None
@@ -111,15 +136,36 @@ class HotReloader:
                     path, OUTCOME_REJECTED_STRUCTURE,
                     "candidate holds no model tree",
                 )
-            if not _same_structure(self.engine.variables, variables):
+            ref = (
+                self.structure_ref if self.structure_ref is not None
+                else self.engine.variables
+            )
+            if not _same_structure(ref, variables):
                 return self._rollback(
                     path, OUTCOME_REJECTED_STRUCTURE,
                     "candidate parameter tree does not match the serving "
                     "model (different arch/config?)",
                 )
+            if self.preparer is not None:
+                try:
+                    variables = self.preparer(variables)
+                except Exception as err:
+                    return self._rollback(
+                        path, OUTCOME_REJECTED_CALIBRATION,
+                        f"quant scale re-verification/calibration failed "
+                        f"({type(err).__name__}: {err})",
+                    )
             try:
                 self.prober(variables)
             except Exception as err:
+                if self.preparer is not None and \
+                        self.preparer_abort is not None:
+                    try:
+                        self.preparer_abort()
+                    except Exception:
+                        logger.exception(
+                            "preparer_abort failed (rollback stands)"
+                        )
                 return self._rollback(
                     path, OUTCOME_REJECTED_PROBE,
                     f"probe batch failed ({type(err).__name__}: {err})",

@@ -18,8 +18,11 @@ the training taxonomy 65-74 in docs/robustness.md):
    immediately (also 77 — the drain did not complete cleanly).
 
 ``--reload-interval`` arms hot checkpoint reload (verify-then-swap with
-rollback); ``--fault-inject`` arms the serving chaos kinds.  See
-docs/serving.md.
+rollback); ``--fault-inject`` arms the serving chaos kinds;
+``--serve-quantize {int8,fp8}`` inserts a calibration pass before warm-up
+and serves the quantized per-bucket programs (dequant fused into the
+consuming ops; reload re-verifies scales and rolls back
+``rejected:calibration`` on mismatch).  See docs/serving.md.
 """
 
 import logging
@@ -98,6 +101,11 @@ def load_serving_model(args):
         if getattr(task, "dictionary", None) is not None
         else 0
     )
+    vocab_size = (
+        len(task.dictionary)
+        if getattr(task, "dictionary", None) is not None
+        else int(getattr(model, "vocab_size", 0) or 0)
+    )
     max_seq_len = int(getattr(ckpt_args, "max_seq_len", 512) or 512)
     hist = state.get("optimizer_history") or []
     step = hist[-1].get("num_updates", "?") if hist else "?"
@@ -105,16 +113,213 @@ def load_serving_model(args):
         f"serving model from {args.path} (step {step}, task "
         f"{type(task).__name__}, max_seq_len {max_seq_len})"
     )
-    return model, variables, pad_idx, max_seq_len
+    return model, variables, pad_idx, max_seq_len, vocab_size
 
 
-def build_engine(args, model, variables, pad_idx, max_seq_len):
+def serve_buckets(args, max_seq_len):
     from unicore_tpu.data.data_utils import compute_length_buckets
-    from unicore_tpu.serve import ServeEngine, build_infer_fn
 
-    edges = compute_length_buckets(args.serve_buckets, max_seq_len) or (
+    return compute_length_buckets(args.serve_buckets, max_seq_len) or (
         max_seq_len,
     )
+
+
+def setup_quantized_serving(args, model, variables, pad_idx, max_seq_len,
+                            vocab_size, edges):
+    """Startup calibration for ``--serve-quantize``: calibrate (or re-use
+    digest-verified persisted scales), prepare the quantized tree, build
+    the sampled drift probe and the hot-reload preparer.  Returns
+    ``(model_q, prepared, quant_extras)`` — any failure here is exit-76
+    territory (there is nothing safe to serve at the requested precision).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from unicore_tpu import telemetry
+    from unicore_tpu.quant import calibrate
+
+    mode = args.serve_quantize
+    if vocab_size <= 0:
+        raise ValueError(
+            "--serve-quantize needs a vocabulary to synthesize calibration "
+            "batches, but the task has no dictionary and the model reports "
+            "no vocab_size"
+        )
+    if not hasattr(model, "quantize"):
+        raise ValueError(
+            f"--serve-quantize {mode}: {type(model).__name__} is not "
+            "quantize-aware (no 'quantize' attr); only models whose dense "
+            "call sites route through QuantDense can serve quantized"
+        )
+    model_q = model.clone(quantize=mode)
+    prepared, info = calibrate.calibrate_for_serving(
+        model_q, model, variables,
+        mode=mode,
+        snapshot_path=args.path,
+        vocab_size=vocab_size,
+        pad_idx=pad_idx,
+        bucket_edges=edges,
+        batch_size=args.serve_batch_size,
+        n_batches=args.calibration_batches,
+    )
+    # prepare() hands back host (numpy) leaves; commit them to device ONCE
+    # or every dispatch would re-transfer the whole tree
+    prepared = jax.device_put(prepared)
+    # the grep-able QUANT-PATH line + journal kind the CI smoke asserts on
+    logger.info(
+        f"QUANT-PATH {info['mode']}: scales {info['source']} for "
+        f"{info['sites']} site(s), calibration max |logit drift| "
+        f"{info['max_abs_logit_drift']:.5f} (rel {info['rel_drift']:.5f}) "
+        f"over {info['batches']} batch(es); scales at {info['scales_path']}"
+    )
+    telemetry.emit(
+        "quant-path", event="calibrated",
+        **{k: v for k, v in info.items() if k != "weights_digest"},
+    )
+
+    # sampled per-request drift probe: its OWN jit (the engine's
+    # recompile watchdog counts only the serving fn's cache).  The holder
+    # keeps the (quantized, fp32) pair in lockstep with hot swaps: the
+    # preparer stages a candidate pair, the engine's swap hook commits it
+    # only when THAT prepared tree actually swaps in, and a probe-rejected
+    # candidate's pair is released via preparer_abort — it neither leaks
+    # device memory nor ever re-pairs the oracle.
+    # the fp32 half of the pair is committed to device alongside the
+    # prepared tree (only when sampling is on — it exists purely for the
+    # oracle): a host-side tree would re-transfer the whole fp32 model
+    # every sampled batch
+    sampling = args.quant_drift_sample > 0
+    oracle = {
+        "q": prepared,
+        "f": jax.device_put(variables) if sampling else variables,
+        # candidate pairs staged by the preparer, committed by the swap
+        # hook (engine loop thread) or released by preparer_abort (reload
+        # thread) — hence the lock
+        "staged": [],
+    }
+    oracle_lock = threading.Lock()
+
+    @jax.jit
+    def _drift(q_vars, f_vars, tokens):
+        lq = model_q.apply(q_vars, tokens, train=False).astype(jnp.float32)
+        lf = model.apply(f_vars, tokens, train=False).astype(jnp.float32)
+        d = jnp.abs(lq - lf)
+        # measure only where responses are cut from (ids[i, :len(r)]):
+        # logits AT pad positions are never returned, and pad tokens are
+        # outside the calibration distribution by construction — their
+        # drift is real but irrelevant to any client
+        if d.ndim >= 2 and tokens.ndim >= 2 \
+                and d.shape[1] == tokens.shape[1]:
+            real = (tokens != pad_idx).astype(jnp.float32)
+            d = d * real.reshape(real.shape + (1,) * (d.ndim - 2))
+        return jnp.max(d, axis=tuple(range(1, d.ndim)))
+
+    def drift_probe(tokens):
+        return _drift(oracle["q"], oracle["f"], tokens)
+
+    if sampling:
+        import numpy as np
+
+        # pre-compile the shadow oracle for every warmed bucket geometry
+        # NOW (startup, like engine warm-up): the first sampled batch per
+        # shape would otherwise pay BOTH XLA compiles inside the live
+        # serving loop, stalling batch formation past request deadlines
+        for edge in edges:
+            dummy = np.full(
+                (args.serve_batch_size, int(edge)), pad_idx, np.int32
+            )
+            jax.block_until_ready(drift_probe(dummy))
+
+    # filled by main() once the engine exists (the hook closure is built
+    # before build_engine); the hook pushes the committed candidate's
+    # calibration info into /stats
+    engine_cell = {}
+
+    def swap_hook(swapped_vars, tag):
+        committed_info = None
+        with oracle_lock:
+            staged = oracle["staged"]
+            for i, (q, f, new_info) in enumerate(staged):
+                if q is swapped_vars:
+                    oracle["q"], oracle["f"] = q, f
+                    committed_info = new_info
+                    # entries staged BEFORE the applied swap are
+                    # superseded (request_swap is latest-wins — theirs
+                    # can never apply); LATER entries belong to
+                    # candidates still in flight and stay staged
+                    del staged[: i + 1]
+                    break
+        eng = engine_cell.get("engine")
+        if committed_info is not None and eng is not None:
+            # /stats must describe the snapshot actually serving: swap in
+            # the re-calibration info and restart the drift aggregate
+            eng.update_quant_info(
+                {k: v for k, v in committed_info.items()
+                 if k != "weights_digest"}
+            )
+
+    def preparer(candidate_vars):
+        """Hot-reload calibration stage: re-verify (digest) or re-derive
+        scales for the CANDIDATE weights; calibrate.CalibrationError (or
+        anything else) becomes a rejected:calibration rollback."""
+        new_prepared, new_info = calibrate.calibrate_for_serving(
+            model_q, model, candidate_vars,
+            mode=mode,
+            snapshot_path=args.path,
+            vocab_size=vocab_size,
+            pad_idx=pad_idx,
+            bucket_edges=edges,
+            batch_size=args.serve_batch_size,
+            n_batches=args.calibration_batches,
+        )
+        new_prepared = jax.device_put(new_prepared)
+        logger.info(
+            f"QUANT-PATH {mode}: reload candidate re-calibrated "
+            f"(scales {new_info['source']}, max |logit drift| "
+            f"{new_info['max_abs_logit_drift']:.5f})"
+        )
+        telemetry.emit(
+            "quant-path", event="reload-calibrated",
+            **{k: v for k, v in new_info.items() if k != "weights_digest"},
+        )
+        with oracle_lock:
+            oracle["staged"].append((
+                new_prepared,
+                jax.device_put(candidate_vars) if sampling
+                else candidate_vars,
+                new_info,
+            ))
+        return new_prepared
+
+    def preparer_abort():
+        """Probe rejected the candidate this preparer just staged: drop
+        its pair (the most recent entry) so a rejected candidate neither
+        leaks two device trees nor ever re-pairs the drift oracle."""
+        with oracle_lock:
+            if oracle["staged"]:
+                oracle["staged"].pop()
+
+    extras = {
+        "precision": mode,
+        "quant_info": {k: v for k, v in info.items()
+                       if k != "weights_digest"},
+        "drift_probe": drift_probe if args.quant_drift_sample > 0 else None,
+        "drift_sample_every": args.quant_drift_sample,
+        "swap_hook": swap_hook,
+        "preparer": preparer,
+        "preparer_abort": preparer_abort,
+        "engine_cell": engine_cell,
+    }
+    return model_q, prepared, extras
+
+
+def build_engine(args, model, variables, pad_idx, max_seq_len,
+                 edges=None, precision="", quant_info=None,
+                 drift_probe=None, drift_sample_every=0, swap_hook=None):
+    from unicore_tpu.serve import ServeEngine, build_infer_fn
+
+    if edges is None:
+        edges = serve_buckets(args, max_seq_len)
     infer_fn, cache_probe = build_infer_fn(model)
     return ServeEngine(
         variables,
@@ -124,6 +329,11 @@ def build_engine(args, model, variables, pad_idx, max_seq_len):
         pad_idx=pad_idx,
         admission_capacity=args.admission_capacity,
         cache_size_probe=cache_probe,
+        precision=precision,
+        quant_info=quant_info,
+        drift_probe=drift_probe,
+        drift_sample_every=drift_sample_every,
+        swap_hook=swap_hook,
     )
 
 
@@ -188,10 +398,29 @@ def main(args) -> int:
         )
     telemetry.configure(args, rank=0, role="serve")
 
-    # 1. verified model load -------------------------------------------------
+    # 1. verified model load (+ calibration when quantizing) -----------------
     try:
-        model, variables, pad_idx, max_seq_len = load_serving_model(args)
-        engine = build_engine(args, model, variables, pad_idx, max_seq_len)
+        model, variables, pad_idx, max_seq_len, vocab_size = \
+            load_serving_model(args)
+        edges = serve_buckets(args, max_seq_len)
+        quant_extras = {}
+        preparer = preparer_abort = None
+        serve_model, serve_variables = model, variables
+        if args.serve_quantize != "off":
+            serve_model, serve_variables, quant_extras = \
+                setup_quantized_serving(
+                    args, model, variables, pad_idx, max_seq_len,
+                    vocab_size, edges,
+                )
+            preparer = quant_extras.pop("preparer")
+            preparer_abort = quant_extras.pop("preparer_abort")
+            engine_cell = quant_extras.pop("engine_cell")
+        engine = build_engine(
+            args, serve_model, serve_variables, pad_idx, max_seq_len,
+            edges=edges, **quant_extras,
+        )
+        if preparer is not None:
+            engine_cell["engine"] = engine
     except Exception as err:
         logger.error(
             f"FATAL: model load failed ({type(err).__name__}: {err}) — "
@@ -242,7 +471,16 @@ def main(args) -> int:
 
         reload_runner = ReloadRunner(
             CheckpointWatcher(args.path),
-            HotReloader(engine, checkpoint_utils.load_checkpoint_to_cpu),
+            HotReloader(
+                engine, checkpoint_utils.load_checkpoint_to_cpu,
+                # quantized serving: candidates re-verify/re-derive scales
+                # (rejected:calibration on failure) and the structure
+                # check runs against the fp32 tree — the engine's live
+                # tree is the PREPARED one
+                preparer=preparer,
+                preparer_abort=preparer_abort,
+                structure_ref=variables if preparer is not None else None,
+            ),
             args.reload_interval,
         )
         reload_runner.start()
