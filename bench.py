@@ -24,6 +24,16 @@ seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
                             carrying the calibration drift bound
                             (BENCH_QUANT_LAYERS/EMBED size the model;
                             docs/serving.md "Quantized inference")
+    BENCH_CONFIG=fleet      the serving FLEET (unicore_tpu/serve/fleet/):
+                            N ∈ {1,2,3} real replica HTTP planes behind
+                            the shedding router (lease-registered over a
+                            file KV, p2c by admission estimate), driven
+                            by a closed-loop worker pool — one aggregate
+                            req/s + p50/p99 row per N
+                            (BENCH_FLEET_SECONDS, BENCH_FLEET_WORKERS;
+                            docs/serving.md "Fleet").  On one CPU the
+                            replicas share cores, so scaling is a
+                            liveness/overhead statement, not a perf claim
     BENCH_CONFIG=kernels    device-side fused-kernel shootout: one row per
                             op pair — softmax_dropout jnp-vs-Pallas,
                             layernorm jnp-vs-Pallas, Adam tree_map-vs-fused
@@ -773,6 +783,169 @@ def run_serve_quant_bench():
 
 
 # ---------------------------------------------------------------------------
+# serving fleet (BENCH_CONFIG=fleet): N replicas behind the router
+# ---------------------------------------------------------------------------
+
+def run_fleet_bench():
+    """Aggregate throughput of the REAL fleet path at N ∈ {1,2,3}
+    replicas: each replica is a full ServeEngine + HTTP plane, lease-
+    registered through a file KV; the router balances by the published
+    admission estimates (p2c) and every request crosses the real proxy
+    leg.  A closed-loop pool of BENCH_FLEET_WORKERS drives each N for
+    BENCH_FLEET_SECONDS; one req/s + p50/p99 row per N.  All replicas
+    share this host's cores, so CPU rows measure fleet-plane overhead
+    and liveness, not scaling — labeled like every other config."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from unicore_tpu.checkpoint.emergency import Deadline
+    from unicore_tpu.data.data_utils import compute_length_buckets
+    from unicore_tpu.models.bert import BertModel
+    from unicore_tpu.serve import ServeEngine, build_infer_fn
+    from unicore_tpu.serve.fleet import (
+        FleetView, ReplicaRegistrar, RouterEngine, open_fleet_kv,
+    )
+    from unicore_tpu.serve.http import bind_server
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "4"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "64"))
+    n_buckets = int(os.environ.get("BENCH_SERVE_BUCKETS", "2"))
+    duration = float(os.environ.get("BENCH_FLEET_SECONDS", "8"))
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", "8"))
+    layers = int(os.environ.get("BENCH_FLEET_LAYERS", "2"))
+    embed = int(os.environ.get("BENCH_FLEET_EMBED", "128"))
+    vocab = 30522
+
+    model = BertModel(
+        vocab_size=vocab,
+        padding_idx=1,
+        encoder_layers=layers,
+        encoder_embed_dim=embed,
+        encoder_ffn_embed_dim=4 * embed,
+        encoder_attention_heads=max(4, embed // 64),
+        max_seq_len=seq_len,
+        post_ln=True,
+    )
+    rng = np.random.RandomState(0)
+    sample = {
+        "net_input": {
+            "src_tokens": rng.randint(
+                4, vocab, size=(batch_size, seq_len)
+            ).astype(np.int64)
+        }
+    }
+    variables = model.init_params(jax.random.PRNGKey(0), sample)
+    edges = compute_length_buckets(n_buckets, seq_len) or (seq_len,)
+    lengths = [max(1, e - 1) for e in edges]
+
+    last = None
+    for n_replicas in (1, 2, 3):
+        engines, servers, registrars = [], [], []
+        with tempfile.TemporaryDirectory() as kv_root:
+            client = open_fleet_kv(kv_root)
+            for i in range(n_replicas):
+                infer_fn, cache_probe = build_infer_fn(model)
+                eng = ServeEngine(
+                    variables, infer_fn, bucket_edges=edges,
+                    batch_size=batch_size, pad_idx=1,
+                    admission_capacity=max(64, batch_size * 8),
+                    cache_size_probe=cache_probe,
+                )
+                eng.warmup()
+                eng.start()
+                srv = bind_server("127.0.0.1", 0, eng,
+                                  read_timeout_s=10.0)
+                srv.start()
+                reg = ReplicaRegistrar(
+                    client, f"b{i}",
+                    f"http://127.0.0.1:{srv.server_address[1]}",
+                    interval_s=0.5,
+                    ready_fn=eng.ready,
+                    est_delay_fn=eng.queue.estimated_delay,
+                    digest_fn=lambda: "bench",
+                    served_fn=lambda e=eng: e.served,
+                ).start()
+                engines.append(eng)
+                servers.append(srv)
+                registrars.append(reg)
+            view = FleetView(client, timeout=30.0)
+            view.poll_once()
+            router = RouterEngine(view)
+            stop = threading.Event()
+            counts = {"ok": 0, "fail": 0}
+            lock = threading.Lock()
+
+            def drive(widx):
+                i = widx
+                while not stop.is_set():
+                    code, _ = router.handle_infer(
+                        {"tokens": [5] * lengths[i % len(lengths)],
+                         "deadline_ms": 60000.0, "id": f"w{widx}-{i}"},
+                        Deadline(60.0),
+                    )
+                    with lock:
+                        counts["ok" if code == 200 else "fail"] += 1
+                    i += len(lengths)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=drive, args=(w,))
+                for w in range(workers)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(duration)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            elapsed = time.perf_counter() - t0
+            for reg in registrars:
+                reg.stop(goodbye=True)
+            for eng in engines:
+                eng.drain(Deadline(60.0))
+            for srv in servers:
+                srv.shutdown()
+
+            stats = router.stats()
+            row = {
+                "metric": (
+                    f"fleet_bert_l{layers}e{embed}_seq{seq_len}_"
+                    f"n{n_replicas}_req_per_sec"
+                ),
+                "value": round(counts["ok"] / elapsed, 2),
+                "unit": "req/s",
+                "vs_baseline": None,
+                "replicas": n_replicas,
+                "workers": workers,
+                "served": counts["ok"],
+                "failed": counts["fail"],
+                "retries": stats["retries"],
+                "shed": sum(stats["shed"].values()),
+                "by_replica": stats["by_replica"],
+                "encoder_layers": layers,
+                "embed_dim": embed,
+            }
+            for k in ("p50_ms", "p90_ms", "p99_ms"):
+                if k in stats:
+                    row[k] = stats[k]
+            _append_partial(row)  # raw number first — diagnostics can hang
+            if os.environ.get("BENCH_CPU_FALLBACK"):
+                row["cpu_fallback"] = True
+            try:
+                row["device_kind"] = jax.devices()[0].device_kind
+            except Exception as e:
+                sys.stderr.write(
+                    f"bench: diagnostics failed (result kept): {e!r}\n"
+                )
+            _append_partial(row)
+            print(json.dumps(row), flush=True)
+            last = row
+    return last
+
+
+# ---------------------------------------------------------------------------
 # fused-kernel shootout (BENCH_CONFIG=kernels)
 # ---------------------------------------------------------------------------
 
@@ -1240,6 +1413,8 @@ def main():
                 runner = run_serve_bench
             elif c == "serve-quant":
                 runner = run_serve_quant_bench
+            elif c == "fleet":
+                runner = run_fleet_bench
             elif c == "kernels":
                 runner = run_kernel_bench
             elif c == "memory":

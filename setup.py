@@ -27,6 +27,7 @@ setup(
         "console_scripts": [
             "unicore-tpu-train = unicore_tpu_cli.train:cli_main",
             "unicore-tpu-serve = unicore_tpu_cli.serve:cli_main",
+            "unicore-tpu-router = unicore_tpu_cli.router:cli_main",
             "unicore-tpu-lint = unicore_tpu_cli.lint:main",
             "unicore-tpu-trace = unicore_tpu_cli.trace:main",
         ],

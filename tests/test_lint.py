@@ -1248,6 +1248,90 @@ def test_unbounded_serve_wait_only_in_serve_package(tmp_path):
     ) == []
 
 
+def test_unbounded_serve_wait_covers_router_cli(tmp_path):
+    """unicore_tpu_cli/router.py is the serving plane's front door: a
+    timeout-less queue pop or event wait there is the exact slow-loris
+    class the rule polices in the replica (positive fixture: router
+    scope)."""
+    home = tmp_path / "unicore_tpu_cli"
+    home.mkdir()
+    path = home / "router.py"
+    path.write_text(textwrap.dedent(
+        """
+        def route(q, stop_event):
+            item = q.get()
+            stop_event.wait()
+            return item
+        """
+    ))
+    vs = lint_paths(
+        [str(path)], rules=build_rules(["unbounded-serve-wait"])
+    )
+    assert rule_names(vs) == ["unbounded-serve-wait"] * 2
+
+
+def test_unbounded_serve_wait_covers_fleet_subpackage(tmp_path):
+    """serve/fleet/ modules ride the serve-package scope: the router's
+    membership/proxy threads hold the same promise (positive fixture:
+    fleet scope)."""
+    home = tmp_path / "serve" / "fleet"
+    home.mkdir(parents=True)
+    path = home / "membershiplike.py"
+    path.write_text(textwrap.dedent(
+        """
+        def wait_round(worker, listener):
+            worker.join()
+            return listener.accept()
+        """
+    ))
+    vs = lint_paths(
+        [str(path)], rules=build_rules(["unbounded-serve-wait"])
+    )
+    assert rule_names(vs) == ["unbounded-serve-wait"] * 2
+
+
+def test_unbounded_serve_wait_router_scope_is_precise(tmp_path):
+    """Only router.py directly under unicore_tpu_cli rides the new
+    scope: a sibling CLI module and a router.py elsewhere keep their own
+    disciplines (negative fixture: router scope)."""
+    cli = tmp_path / "unicore_tpu_cli"
+    cli.mkdir()
+    sibling = cli / "train.py"
+    sibling.write_text("def pump(q):\n    return q.get()\n")
+    elsewhere = tmp_path / "tools"
+    elsewhere.mkdir()
+    lookalike = elsewhere / "router.py"
+    lookalike.write_text("def pump(q):\n    return q.get()\n")
+    assert lint_paths(
+        [str(sibling), str(lookalike)],
+        rules=build_rules(["unbounded-serve-wait"]),
+    ) == []
+
+
+def test_unbounded_serve_wait_router_bounded_forms_pass(tmp_path):
+    """Deadline-bounded waits inside the router CLI stay un-flagged —
+    the scope extension polices the unbounded SHAPE, not the file
+    (negative fixture: router scope)."""
+    home = tmp_path / "unicore_tpu_cli"
+    home.mkdir()
+    path = home / "router.py"
+    path.write_text(textwrap.dedent(
+        """
+        from unicore_tpu.utils import retry
+
+        def route(q, stop_event, worker):
+            item = q.get(timeout=0.5)
+            stop_event.wait(timeout=0.2)
+            worker.join(2.0)
+            retry.bounded_wait(stop_event.is_set, timeout=5.0)
+            return item
+        """
+    ))
+    assert lint_paths(
+        [str(path)], rules=build_rules(["unbounded-serve-wait"])
+    ) == []
+
+
 # ---------------------------------------------------------------------------
 # untracked-verdict-event
 # ---------------------------------------------------------------------------

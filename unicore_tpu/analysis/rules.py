@@ -1089,12 +1089,20 @@ class UnguardedKvWait(LintRule):
 # through utils/retry.py (bounded_wait / kv_wait poll in deadline-bounded
 # slices).  '# lint: serve-deadline-bounded' justifies a call whose bound
 # lives elsewhere (e.g. a socket with settimeout set at setup).
+#
+# Scope: the serve package (which includes serve/fleet/) AND the router
+# CLI (unicore_tpu_cli/router.py) — the router is the serving plane's
+# front door, and a timeout-less socket/queue wait there is the exact
+# slow-loris class PR 7 fixed in the replica transport.
 _SERVE_HOME = "serve"
+_ROUTER_CLI = ("unicore_tpu_cli", "router.py")
 
 
 def _in_serve_package(path: str) -> bool:
     parts = os.path.normpath(path).split(os.sep)
-    return _SERVE_HOME in parts[:-1]
+    if _SERVE_HOME in parts[:-1]:
+        return True
+    return tuple(parts[-2:]) == _ROUTER_CLI
 
 
 def _has_kwarg(call: ast.Call, name: str) -> bool:
@@ -1122,9 +1130,10 @@ class UnboundedServeWait(LintRule):
     justifications = ("serve-deadline-bounded",)
     description = (
         "unbounded blocking wait (queue get/put, event/condition wait, "
-        "join, socket accept without a timeout) inside unicore_tpu/serve/:"
-        " the serving plane promises every wait is deadline-bounded — a "
-        "slow client or wedged consumer must time out with a named "
+        "join, socket accept without a timeout) inside unicore_tpu/serve/ "
+        "(incl. serve/fleet/) or unicore_tpu_cli/router.py: the serving "
+        "plane promises every wait is deadline-bounded — a slow client, "
+        "a wedged consumer, or a dark replica must time out with a named "
         "reason, never hold a worker forever.  Pass a timeout, route "
         "through utils/retry.bounded_wait, or justify a call bounded "
         "elsewhere with '# lint: serve-deadline-bounded'"

@@ -97,6 +97,21 @@ Kinds (all persistent from STEP onward unless noted):
     the server keeps answering from the serving snapshot — a corrupt
     reload must never take down a healthy server.  Consumed after one
     candidate.
+``replica-loss@BATCH[@IDX]``
+    Serving fleet: the replica whose ``--replica-index`` is IDX (any
+    replica when omitted) hard-exits (``os._exit``, no drain, no lease
+    goodbye — a machine dying mid-fleet) once its Nth serve batch has
+    dispatched.  The router must shed around it (connect failures
+    down-mark it immediately) and the fleet membership must name it
+    with a replica-loss verdict within the lease timeout.  One-shot by
+    construction.
+``replica-stall[:SECS]@BATCH[@IDX]``
+    Serving fleet: the targeted replica's ``/v1/infer`` handler WEDGES
+    for SECS (default 3600) from batch BATCH onward while its heartbeat
+    lease keeps publishing — the zombie replica whose lease health
+    looks perfect.  Proves the router's deadline-bounded proxy leg is
+    what sheds around a live-but-dark replica, the case lease liveness
+    alone can never catch.
 
 The three elastic kinds above arm only on the FIRST incarnation of an
 elastic run (membership epoch 0, restart count 0): a restarted child
@@ -142,12 +157,19 @@ KINDS = (
     "request-flood",
     "slow-client",
     "corrupt-reload",
+    "replica-loss",
+    "replica-stall",
 )
 
 # serving-plane kinds (consumed by unicore_tpu/serve/ + the serve CLI);
 # serving is single-process, so every one of them fires on "this" rank —
 # @RANK targeting is meaningless and rejected
 _SERVE_KINDS = ("request-flood", "slow-client", "corrupt-reload")
+
+# fleet kinds target one REPLICA of a serving fleet: the third spec
+# field is a replica index (matched against set_replica_index / the
+# serve CLI's --replica-index), never a jax process rank
+_REPLICA_KINDS = ("replica-loss", "replica-stall")
 
 # metric-fault kinds perturb REPLICATED jit inputs, so they must fire
 # identically on every rank — @RANK targeting is rejected for them
@@ -210,6 +232,8 @@ class FaultPlan:
             )
         self.kind = kind
         self.step = step
+        # for _REPLICA_KINDS the third field is a replica INDEX (matched
+        # against set_replica_index), not a jax rank
         self._rank = rank  # None = resolve to last rank at trigger time
         self.param = param
         self.consumed = False  # one-shot metric faults: never refire after
@@ -236,6 +260,9 @@ class FaultPlan:
             or self.kind in _SERVE_KINDS
         ):
             return True
+        if self.kind in _REPLICA_KINDS:
+            # replica targeting, no jax involved: IDX omitted = any
+            return self._rank is None or self._rank == _replica_index
         import jax
 
         return jax.process_index() == self.rank
@@ -245,6 +272,9 @@ class FaultPlan:
         return step >= self.step and self.on_this_rank()
 
     def __repr__(self):
+        if self.kind in _REPLICA_KINDS:
+            idx = self._rank if self._rank is not None else "<any>"
+            return f"FaultPlan({self.kind}@{self.step}@replica{idx})"
         if self.kind in _SERVE_KINDS:
             return f"FaultPlan({self.kind}@{self.step}@serve)"
         if self.kind in _ALL_RANK_KINDS or self.kind in _SERVICE_KINDS:
@@ -280,6 +310,9 @@ _last_step: int = 0
 # wall clock of the first step at/after a windowed (service/heartbeat)
 # fault's trigger — the [:SECS] window is measured from here
 _window_started: Optional[float] = None
+# which fleet replica this process is (--replica-index); the @IDX part
+# of the replica-targeted kinds matches against it
+_replica_index: int = 0
 
 
 def _elastic_incarnation() -> int:
@@ -322,10 +355,19 @@ def configure(args) -> Optional[FaultPlan]:
 
 
 def reset() -> None:
-    global _plan, _last_step, _window_started
+    global _plan, _last_step, _window_started, _replica_index
     _plan = None
     _last_step = 0
     _window_started = None
+    _replica_index = 0
+
+
+def set_replica_index(idx: int) -> None:
+    """Record which fleet replica this serve process is (the serve CLI's
+    ``--replica-index``) so ``@IDX``-targeted replica kinds know whether
+    they are armed here."""
+    global _replica_index
+    _replica_index = int(idx)
 
 
 def note_step(step: int) -> None:
@@ -622,6 +664,47 @@ def note_serve_batch(seq: int) -> None:
     instead (``@0`` = from startup)."""
     global _last_step
     _last_step = seq
+    maybe_replica_loss(seq)
+
+
+def maybe_replica_loss(seq: int) -> None:
+    """``replica-loss``: hard-exit the targeted replica — ``os._exit``,
+    no drain, no lease goodbye, the key left rotting in the store.  The
+    fleet-tier equivalent of ``host-loss``: the router learns about it
+    only from connect failures and the silent lease."""
+    if (
+        _plan is None
+        or _plan.kind != "replica-loss"
+        or _plan.consumed
+        or not _plan.active(seq)
+    ):
+        return
+    _plan.consumed = True
+    import os
+    import sys
+
+    logger.warning(
+        f"chaos: REPLICA LOSS — replica {_replica_index} hard-exiting "
+        f"after serve batch {seq} (no drain, no lease goodbye; the "
+        "router must shed around the silence)"
+    )
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(HOST_LOSS_EXIT_CODE)
+
+
+_DEFAULT_REPLICA_STALL_SECONDS = 3600.0
+
+
+def replica_stall_active() -> bool:
+    """``replica-stall``: True while the targeted replica's HTTP plane
+    must wedge (its ``/v1/infer`` handler blocks) even though the lease
+    publisher keeps beating — the zombie replica whose lease health
+    looks perfect.  The router's deadline-bounded proxy leg is the only
+    guard that catches it."""
+    return _windowed_active(
+        "replica-stall", _DEFAULT_REPLICA_STALL_SECONDS
+    )
 
 
 def serve_flood_qps() -> float:

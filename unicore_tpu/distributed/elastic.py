@@ -335,6 +335,28 @@ class LeaseTable:
         }
         self._kv_ok = now
 
+    def add_peer(self, rank: int, now: float) -> None:
+        """Start tracking a peer first seen after construction (the serve
+        fleet's membership is dynamic: replicas register by publishing a
+        lease, unlike training's fixed launch-time world).  Idempotent;
+        the clocks start at ``now`` so a just-joined peer owes no
+        silence."""
+        self._last.setdefault(int(rank), [None, now, now])
+
+    def remove_peer(self, rank: int) -> None:
+        """Stop tracking a peer (deregistered, or already declared lost
+        and acted on — keeping it would re-mint the same verdict every
+        sweep)."""
+        self._last.pop(int(rank), None)
+
+    def note_service_ok(self, now: float) -> None:
+        """The store answered — even about nothing (an empty membership
+        listing).  Re-arms the control-plane outage clock; without it a
+        healthy-but-empty fleet would trip a spurious outage verdict,
+        since per-peer ``observe`` calls are the only other thing that
+        advances it."""
+        self._kv_ok = now
+
     def observe(self, rank: int, result: Any, now: float) -> Optional[Verdict]:
         """Feed one probe outcome for ``rank``: a :class:`Lease`,
         ``retry.ABSENT`` (service answered: no/empty key) or

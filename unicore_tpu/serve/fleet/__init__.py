@@ -1,0 +1,65 @@
+"""The replica tier: from one serving process to a fleet.
+
+Composition over new planes (the TorchTitan argument, PAPERS.md arXiv
+2410.06511): every rail here already existed before this package did —
+
+* **registration/liveness** rides PR 6's heartbeat-lease plane
+  (``distributed/elastic.py`` Lease/LeaseTable, serve-namespaced keys,
+  the service-confirmed silence rule: a KV outage freezes verdicts, it
+  never mints them);
+* **balancing** rides PR 12's per-bucket admission estimate — each
+  replica's lease publishes its own ``/stats`` queue-delay number and
+  the router spreads by power-of-two-choices over it;
+* **deadlines** ride PR 5's ``Deadline`` end-to-end: the proxy leg's
+  socket timeout and the downstream ``deadline_ms`` are both the
+  request's REMAINING budget;
+* **retries** ride the audited ``utils/retry.py`` policy surface
+  (connect failures / replica 5xx re-route to a different replica,
+  never after the request body streamed);
+* **rolling reload** rides PR 7's verify→probe→swap verbatim, one
+  replica at a time with halt-on-first-rollback — a bad checkpoint's
+  blast radius is one replica's verify window;
+* **observability** rides PR 8's journal (``fleet-verdict`` /
+  ``router-shed`` / ``router-retry`` / ``fleet-reload`` kinds) and
+  Prometheus counters, merged by ``unicore-tpu-trace``.
+
+See docs/serving.md "Fleet"; ``unicore_tpu_cli/router.py``
+(``unicore-tpu-router``) is the operator entry point.
+"""
+
+from unicore_tpu.serve.fleet.http import RouterHTTPServer, bind_router
+from unicore_tpu.serve.fleet.kv import (
+    FileKVClient,
+    FleetKVError,
+    open_fleet_kv,
+)
+from unicore_tpu.serve.fleet.membership import (
+    FleetView,
+    MembershipRunner,
+    ReplicaInfo,
+)
+from unicore_tpu.serve.fleet.registry import (
+    ReplicaLease,
+    ReplicaRegistrar,
+    decode_replica_lease,
+    model_digest,
+)
+from unicore_tpu.serve.fleet.rolling import RollingReload
+from unicore_tpu.serve.fleet.router import RouterEngine
+
+__all__ = [
+    "FileKVClient",
+    "FleetKVError",
+    "FleetView",
+    "MembershipRunner",
+    "ReplicaInfo",
+    "ReplicaLease",
+    "ReplicaRegistrar",
+    "RollingReload",
+    "RouterEngine",
+    "RouterHTTPServer",
+    "bind_router",
+    "decode_replica_lease",
+    "model_digest",
+    "open_fleet_kv",
+]

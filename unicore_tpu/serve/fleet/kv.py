@@ -1,0 +1,182 @@
+"""Fleet coordination KV: the same client shape training's control plane
+speaks, backed by a shared directory.
+
+The elastic plane (PR 6) already settled how liveness is exchanged — a
+KV store of heartbeat leases, probed through ``utils/retry.kv_fetch``
+which CLASSIFIES outcomes (value / ABSENT / UNREACHABLE) so silence from
+a peer is never confused with silence from the service.  The serving
+fleet reuses that plane verbatim; the only new piece is WHERE the KV
+lives: serve replicas are independent processes (no ``jax.distributed``
+cluster to carry the coordination service), so :class:`FileKVClient`
+provides the same duck-typed client over a shared directory — one file
+per key, atomic publish via ``os.replace``, absence reported as the
+client's own deadline expiring (exactly how the jax client reports "no
+key yet"), an unreachable root reported as a connection failure.
+
+Because the shape matches, every consumer goes through the audited
+``utils/retry.py`` helpers unchanged (the ``unguarded-kv-wait`` lint
+discipline holds), and the ``kv-outage`` chaos kind darkens this store
+the same way it darkens the real one.  A deployment that already runs a
+coordination service can hand the router/replicas that client instead —
+nothing in fleet/ touches anything beyond the four methods below.
+"""
+
+import logging
+import os
+import re
+import time
+from typing import List, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: serve-namespaced key prefix: elastic training heartbeats live under
+#: ``unicore_tpu/elastic/...`` — a training run and a serve fleet sharing
+#: one store can never collide
+FLEET_PREFIX = "unicore_tpu/serve/fleet"
+
+_SAFE_COMPONENT = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+class FleetKVError(RuntimeError):
+    """The fleet KV root is unusable (missing, not a directory, or not
+    writable) — startup-fatal for a registrar/router, never a mid-run
+    crash (mid-run trouble classifies as UNREACHABLE instead)."""
+
+
+def check_name(name: str) -> str:
+    """Replica names become KV key components and file names; keep them
+    boring so neither layer needs escaping."""
+    if not _SAFE_COMPONENT.match(name or ""):
+        raise ValueError(
+            f"replica name {name!r} must match [A-Za-z0-9._-]+ "
+            "(it names a KV key and a journal field)"
+        )
+    return name
+
+
+class FileKVClient:
+    """Directory-backed KV with the jax coordination client's surface:
+    ``key_value_set`` / ``blocking_key_value_get`` / ``key_value_delete``
+    / ``key_value_dir_get``.
+
+    Outcome contract (what ``retry.kv_fetch`` classifies on):
+
+    * key present → its string value;
+    * key absent → ``TimeoutError('...deadline exceeded...')`` after the
+      poll budget, like the real client's blocking get;
+    * root missing/unreadable → ``ConnectionError`` (UNREACHABLE — the
+      service itself did not answer).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+
+    def _path(self, key: str) -> str:
+        # keys are slash-namespaced; keep the hierarchy on disk
+        parts = [p for p in str(key).split("/") if p and p != ".."]
+        return os.path.join(self.root, *parts)
+
+    def _check_root(self) -> None:
+        if not os.path.isdir(self.root):
+            raise ConnectionError(
+                f"fleet KV root {self.root} is not a directory"
+            )
+
+    # -- client surface --------------------------------------------------
+
+    def key_value_set(self, key: str, value: str,
+                      allow_overwrite: bool = True) -> None:
+        self._check_root()
+        path = self._path(key)
+        if not allow_overwrite and os.path.exists(path):
+            raise ValueError(f"key {key} already set")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(value))
+        os.replace(tmp, path)  # readers see whole values or nothing
+
+    def blocking_key_value_get(self, key: str, timeout_ms: int) -> str:
+        self._check_root()
+        deadline = time.monotonic() + max(1, int(timeout_ms)) / 1000.0
+        path = self._path(key)
+        while True:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    return f.read()
+            except FileNotFoundError:
+                pass
+            if time.monotonic() >= deadline:
+                # worded like the real client so retry's classifier
+                # (_looks_like_kv_timeout) reads it as ABSENT, not a raise
+                raise TimeoutError(
+                    f"deadline exceeded waiting for key {key}"
+                )
+            time.sleep(min(0.02, max(0.0, deadline - time.monotonic())))
+
+    def key_value_delete(self, key: str) -> None:
+        self._check_root()
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def key_value_dir_get(self, prefix: str) -> List[Tuple[str, str]]:
+        """Every (key, value) under ``prefix`` — the router's membership
+        listing.  A torn read can't happen (writes are atomic replaces);
+        a file vanishing mid-walk (deregistration) is skipped."""
+        self._check_root()
+        base = self._path(prefix)
+        out: List[Tuple[str, str]] = []
+        if not os.path.isdir(base):
+            return out
+        for entry in sorted(os.listdir(base)):
+            if entry.endswith(".tmp") or ".tmp." in entry:
+                continue
+            path = os.path.join(base, entry)
+            if not os.path.isfile(path):
+                continue
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    out.append((f"{prefix}/{entry}", f.read()))
+            except OSError:
+                continue
+        return out
+
+
+def open_fleet_kv(root: str, *, create: bool = True) -> FileKVClient:
+    """The operator entry point: resolve ``--fleet-kv DIR`` into a
+    client, creating the root when asked.  Raises :class:`FleetKVError`
+    on an unusable root — the CLIs map it to a documented exit code."""
+    root = os.path.abspath(root)
+    if create:
+        try:
+            os.makedirs(root, exist_ok=True)
+        except OSError as err:
+            raise FleetKVError(
+                f"cannot create fleet KV root {root}: {err}"
+            ) from err
+    if not os.path.isdir(root):
+        raise FleetKVError(f"fleet KV root {root} is not a directory")
+    if not os.access(root, os.R_OK | os.W_OK | os.X_OK):
+        raise FleetKVError(f"fleet KV root {root} is not read/writable")
+    return FileKVClient(root)
+
+
+def kv_list(client, prefix: str):
+    """One classified membership listing: a list of (key, value) pairs,
+    or ``retry.UNREACHABLE`` when the service did not answer (real
+    failure or injected ``kv-outage``).  The router keys on the
+    distinction exactly like the heartbeat monitor: an unanswered
+    listing is evidence about the CONTROL PLANE, and must freeze the
+    membership clocks rather than age any replica's lease."""
+    from unicore_tpu.distributed import chaos
+    from unicore_tpu.utils import retry
+
+    if chaos.kv_outage_active():
+        return retry.UNREACHABLE
+    try:
+        return list(client.key_value_dir_get(prefix))
+    except Exception as err:
+        logger.debug(f"fleet KV listing failed: {err}")
+        return retry.UNREACHABLE

@@ -1,0 +1,220 @@
+"""Replica registration: serve-namespaced heartbeat leases.
+
+A replica IS a host from the control plane's point of view, so its
+liveness rides the exact machinery PR 6 built for training hosts: an
+:class:`~unicore_tpu.distributed.elastic.Lease` (epoch / monotone seq /
+progress / wall stamp) published every interval, silence classified by
+the same service-confirmed rule.  The serve lease wraps that heartbeat
+core with what a ROUTER additionally needs to balance and verify:
+
+* ``address`` — where the replica's HTTP plane answers;
+* ``ready`` — the replica's own ``/readyz`` truth at publish time (a
+  draining or mid-reload replica advertises itself out of the balance
+  set one beat early, before any router probes it);
+* ``digest`` — the serving snapshot's weights digest, so a fleet-wide
+  view can tell which replicas serve which checkpoint mid-rolling-reload;
+* ``est_delay_s`` — the replica's ``/stats`` admission estimate
+  (``AdmissionQueue.estimated_delay``), the router's balance signal.
+
+Keys live under ``unicore_tpu/serve/fleet/hb/<name>`` — namespaced away
+from training's ``unicore_tpu/elastic/hb/...`` so an elastic run and a
+serve fleet sharing one store never collide.
+"""
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from unicore_tpu.distributed import elastic
+from unicore_tpu.serve.fleet.kv import FLEET_PREFIX, check_name
+
+logger = logging.getLogger(__name__)
+
+_SERVE_LEASE_TAG = "uctp-serve1"
+
+HB_PREFIX = f"{FLEET_PREFIX}/hb"
+
+
+def lease_key(name: str) -> str:
+    return f"{HB_PREFIX}/{check_name(name)}"
+
+
+def name_of_key(key: str) -> str:
+    return str(key).rsplit("/", 1)[-1]
+
+
+@dataclass
+class ReplicaLease:
+    """One replica heartbeat: the elastic lease core plus the serve
+    fields the router balances and verifies on."""
+
+    name: str
+    address: str
+    ready: bool
+    digest: str
+    est_delay_s: float
+    hb: elastic.Lease
+
+    def encode(self) -> str:
+        return json.dumps({
+            "tag": _SERVE_LEASE_TAG,
+            "name": self.name,
+            "addr": self.address,
+            "ready": bool(self.ready),
+            "digest": self.digest,
+            "est_delay_s": round(float(self.est_delay_s), 6),
+            "hb": elastic.encode_lease(self.hb),
+        })
+
+
+def decode_replica_lease(raw: str) -> ReplicaLease:
+    doc = json.loads(str(raw))
+    if not isinstance(doc, dict) or doc.get("tag") != _SERVE_LEASE_TAG:
+        raise ValueError(f"not a serve replica lease: {raw!r}")
+    return ReplicaLease(
+        name=str(doc["name"]),
+        address=str(doc["addr"]),
+        ready=bool(doc.get("ready", False)),
+        digest=str(doc.get("digest", "")),
+        est_delay_s=float(doc.get("est_delay_s", 0.0)),
+        hb=elastic.decode_lease(doc["hb"]),
+    )
+
+
+def model_digest(variables) -> str:
+    """Content digest of a serving snapshot's weights — what a fleet
+    view uses to tell which replicas serve which checkpoint.  One pass
+    over the leaf bytes at startup and after each hot swap (both already
+    pay a full-tree operation; the hash is noise next to the load)."""
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def fold(prefix, node):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                fold(f"{prefix}/{k}", node[k])
+            return
+        arr = np.asarray(node)
+        h.update(prefix.encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+
+    fold("", variables)
+    return h.hexdigest()[:16]
+
+
+class ReplicaRegistrar:
+    """Publisher thread: one serve lease per interval, plus two forced
+    out-of-band beats — ``publish_now`` when readiness flips (the drain
+    handshake must not wait out the interval) and a deletion goodbye on
+    clean shutdown so the router DEREGISTERS the replica instead of
+    waiting the lease timeout to declare it lost."""
+
+    def __init__(self, client, name: str, address: str, *,
+                 interval_s: float,
+                 ready_fn: Callable[[], bool],
+                 est_delay_fn: Callable[[], float],
+                 digest_fn: Callable[[], str],
+                 served_fn: Optional[Callable[[], int]] = None):
+        self.client = client
+        self.name = check_name(name)
+        self.address = str(address)
+        self.interval_s = max(0.1, float(interval_s))
+        self._ready_fn = ready_fn
+        self._est_delay_fn = est_delay_fn
+        self._digest_fn = digest_fn
+        self._served_fn = served_fn or (lambda: 0)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.published = 0
+        self.publish_errors = 0
+
+    def _lease(self) -> ReplicaLease:
+        self._seq += 1
+        return ReplicaLease(
+            name=self.name,
+            address=self.address,
+            ready=bool(self._ready_fn()),
+            digest=str(self._digest_fn()),
+            est_delay_s=float(self._est_delay_fn()),
+            hb=elastic.Lease(
+                epoch=0, seq=self._seq, step=int(self._served_fn()),
+                wall=time.time(),
+            ),
+        )
+
+    def publish_now(self) -> None:
+        """One immediate beat (readiness flips, drain begin).  Publish
+        failures are counted, never raised — the replica must keep
+        serving through a KV blip; the router's freeze rule covers the
+        gap."""
+        with self._lock:
+            try:
+                self.client.key_value_set(
+                    lease_key(self.name), self._lease().encode(),
+                    allow_overwrite=True,
+                )
+                self.published += 1
+            except Exception as err:
+                self.publish_errors += 1
+                if self.publish_errors <= 3:
+                    logger.warning(
+                        f"replica lease publish failed ({err}); the fleet "
+                        "store may be dark — serving continues, the router "
+                        "freezes rather than minting verdicts"
+                    )
+
+    def start(self) -> "ReplicaRegistrar":
+        self.publish_now()  # registered before the first interval elapses
+        self._thread = threading.Thread(
+            target=self._run, name="serve-fleet-registrar", daemon=True
+        )
+        self._thread.start()
+        logger.info(
+            f"FLEET REGISTERED: replica {self.name} at {self.address} "
+            f"(lease every {self.interval_s:g}s)"
+        )
+        from unicore_tpu import telemetry
+
+        telemetry.emit(
+            "fleet-replica", event="registered", replica=self.name,
+            address=self.address,
+        )
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(timeout=self.interval_s):
+            self.publish_now()
+
+    def stop(self, goodbye: bool = True) -> None:
+        """Stop publishing; with ``goodbye`` the lease key is DELETED so
+        the router sees a service-confirmed deregistration (clean drain)
+        instead of a silence that ripens into a replica-loss verdict."""
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if goodbye:
+            try:
+                self.client.key_value_delete(lease_key(self.name))
+                logger.info(
+                    f"FLEET DEREGISTERED: replica {self.name} said goodbye"
+                )
+                from unicore_tpu import telemetry
+
+                telemetry.emit(
+                    "fleet-replica", event="deregistered",
+                    replica=self.name,
+                )
+            except Exception as err:
+                logger.warning(
+                    f"lease goodbye failed ({err}); the router will "
+                    "deregister on the missing key or expire the lease"
+                )

@@ -1,0 +1,185 @@
+"""Rolling fleet reload: one replica at a time, blast radius of one.
+
+PR 7 already made a single replica's reload safe (poll → CRC-verified
+load → probe batch → swap on a batch boundary, any failure a named
+``RELOAD ROLLBACK`` that keeps the serving snapshot).  This module
+composes that protocol across the fleet WITHOUT re-implementing any of
+it: the router watches the published checkpoint with the same
+:class:`~unicore_tpu.serve.reload.CheckpointWatcher`, and on a new
+candidate walks the replicas in stable name order, telling each one —
+via its ``POST /v1/reload`` endpoint, which runs the replica's OWN
+verify→probe→swap — to consider the candidate.  The composition rule is
+the whole point:
+
+* **one at a time**: the next replica is asked only after the previous
+  one answered ``swapped`` — at any instant at most one replica is
+  mid-reload (its ``/readyz`` is false and the router down-marks it for
+  the duration, so traffic flows around it);
+* **halt on first rollback**: any outcome other than ``swapped`` (a
+  ``rejected:*`` rollback, a transport failure, a reload that outran its
+  budget) HALTS the roll — the failed replica has already rolled itself
+  back to the old snapshot (PR 7's guarantee), every replica after it is
+  never asked, and the fleet keeps serving the old snapshot with N-1 …
+  N routable replicas.  A bad or corrupt checkpoint can therefore never
+  take down more than one replica, and that one only for the length of
+  its own verify window.
+
+A halted candidate is remembered by the watcher's signature tracking and
+never retried until it is re-published — same consumed-once rule as the
+single-replica watcher.
+"""
+
+import json
+import logging
+import threading
+from http.client import HTTPConnection
+from typing import List, Optional
+
+from unicore_tpu.serve.fleet.membership import FleetView
+from unicore_tpu.serve.fleet.router import host_port
+from unicore_tpu.serve.reload import OUTCOME_SWAPPED, CheckpointWatcher
+
+logger = logging.getLogger(__name__)
+
+
+class RollingReload:
+    """Watcher + one-at-a-time orchestration for the router process."""
+
+    def __init__(self, watcher: CheckpointWatcher, view: FleetView, *,
+                 interval_s: float, reload_timeout_s: float = 300.0):
+        self.watcher = watcher
+        self.view = view
+        self.interval_s = max(0.1, float(interval_s))
+        self.reload_timeout_s = float(reload_timeout_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.rolled = 0
+        self.halted = 0
+        self.last_outcome: Optional[str] = None
+
+    # -- one roll ---------------------------------------------------------
+
+    def _ask_replica(self, name: str, address: str, path: str) -> str:
+        """One replica's verdict on the candidate: its own
+        verify→probe→swap, answered synchronously.  Transport trouble is
+        an outcome too (``unreachable``) — a replica that cannot even be
+        ASKED must halt the roll exactly like one that rolled back."""
+        host, port = host_port(address)
+        conn = HTTPConnection(host, port, timeout=self.reload_timeout_s)
+        try:
+            body = json.dumps({"path": path}).encode("utf-8")
+            conn.request("POST", "/v1/reload", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            doc = json.loads(resp.read().decode("utf-8"))
+            if resp.status != 200:
+                return str(doc.get("outcome")
+                           or f"http-{resp.status}")
+            return str(doc.get("outcome", "unparseable"))
+        except Exception as err:
+            return f"unreachable ({type(err).__name__}: {err})"
+        finally:
+            conn.close()
+
+    def roll(self, path: str) -> List[tuple]:
+        """Walk the fleet for one candidate; returns the per-replica
+        ``(name, outcome)`` history (stops at the first non-swap)."""
+        from unicore_tpu import telemetry
+
+        replicas = sorted(
+            self.view.balance_set(), key=lambda r: r.name
+        )
+        if not replicas:
+            logger.warning(
+                f"ROLLING RELOAD SKIPPED: no routable replica to offer "
+                f"{path} to (it stays pending re-publish)"
+            )
+            return []
+        logger.info(
+            f"ROLLING RELOAD: candidate {path} across "
+            f"{len(replicas)} replica(s), one at a time"
+        )
+        telemetry.emit(
+            "fleet-reload", event="start", path=path,
+            replicas=[r.name for r in replicas],
+        )
+        history: List[tuple] = []
+        for info in replicas:
+            if self._stop.is_set():
+                break
+            # out of the balance set for the duration of ITS reload —
+            # the replica's own /readyz flips false too; this just saves
+            # the races in between
+            self.view.set_reloading(info.name, True)
+            try:
+                outcome = self._ask_replica(info.name, info.address, path)
+            finally:
+                self.view.set_reloading(info.name, False)
+            history.append((info.name, outcome))
+            self.last_outcome = outcome
+            telemetry.emit(
+                "fleet-reload", event="replica-outcome",
+                replica=info.name, outcome=outcome, path=path,
+            )
+            if outcome != OUTCOME_SWAPPED:
+                self.halted += 1
+                logger.error(
+                    f"ROLLING RELOAD HALT: replica {info.name} answered "
+                    f"'{outcome}' for {path} — it has rolled back to the "
+                    f"serving snapshot (PR-7 guarantee), the "
+                    f"{len(replicas) - len(history)} remaining replica(s) "
+                    "were never asked, and the fleet keeps serving the "
+                    "old snapshot.  Blast radius: one replica's verify "
+                    "window."
+                )
+                telemetry.emit(
+                    "fleet-reload", event="halt", replica=info.name,
+                    outcome=outcome, path=path,
+                    never_asked=len(replicas) - len(history),
+                )
+                return history
+            logger.info(
+                f"ROLLING RELOAD: replica {info.name} swapped "
+                f"({len(history)}/{len(replicas)})"
+            )
+        self.rolled += 1
+        logger.info(
+            f"ROLLING RELOAD COMPLETE: {len(history)}/{len(replicas)} "
+            f"replica(s) swapped to {path}"
+        )
+        telemetry.emit(
+            "fleet-reload", event="complete", path=path,
+            swapped=len(history),
+        )
+        return history
+
+    # -- runner -----------------------------------------------------------
+
+    def start(self) -> "RollingReload":
+        self._thread = threading.Thread(
+            target=self._run, name="router-rolling-reload", daemon=True
+        )
+        self._thread.start()
+        logger.info(
+            f"rolling reload armed: watching {self.watcher.path} every "
+            f"{self.interval_s:g}s, one replica at a time"
+        )
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                candidate = self.watcher.poll()
+                if candidate is not None:
+                    self.roll(candidate)
+            except Exception:
+                # the reload plane must never take the router down
+                logger.exception(
+                    "rolling reload poll failed; routing continues"
+                )
+            self._stop.wait(timeout=self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,359 @@
+"""The shedding router: spread, bound, retry — never buffer.
+
+``unicore-tpu-router`` fronts a fleet of ``unicore-tpu-serve`` replicas
+with the same first rule the replicas themselves obey: overload and
+failure resolve to an immediate NAMED outcome, never an unbounded wait.
+
+* **Spread**: power-of-two-choices over the balance set — two random
+  routable replicas, the one with the lower score wins (score = the
+  replica's own lease-published ``/stats`` admission estimate, local
+  in-flight count as the freshness tiebreak between lease rounds).
+  P2C keeps the herd off the momentarily-best replica without the
+  router needing a global queue.
+* **Bound**: every proxy leg carries the request's PR-5 ``Deadline``
+  end-to-end — the downstream ``deadline_ms`` is rewritten to the
+  REMAINING budget (so replicas expire exactly what the client would),
+  and the leg's socket timeout is the same remaining budget.  A wedged
+  replica (chaos ``replica-stall``: lease healthy, HTTP dark) costs one
+  deadline, gets down-marked, and the fleet sheds around it — the case
+  lease health alone can never catch.
+* **Retry**: connect failures and replica-local 5xx re-route to a
+  DIFFERENT replica under a per-request retry budget
+  (``utils/retry.retry_call`` — the audited policy surface), with one
+  hard exception: once the request body has streamed to a replica, the
+  attempt is never retried (the replica may have executed it; a
+  mid-response drop returns a named 502 instead of recomputing).
+* **Shed**: an empty balance set is an immediate 503
+  (``no-ready-replica``, ``Retry-After`` attached) — the router holds
+  no queue of its own; the replicas' admission queues are the only
+  buffering in the system, and they are bounded.
+"""
+
+import json
+import logging
+import random
+import socket
+import threading
+import time
+from http.client import HTTPConnection, HTTPException
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from unicore_tpu.checkpoint.emergency import Deadline
+from unicore_tpu.serve.fleet.membership import FleetView, ReplicaInfo
+from unicore_tpu.utils import retry
+
+logger = logging.getLogger(__name__)
+
+# router shed reasons (the router's own vocabulary; replica sheds pass
+# through with the replica's reason untouched)
+SHED_NO_REPLICA = "no-ready-replica"
+SHED_RETRY_BUDGET = "retry-budget-exhausted"
+SHED_DEADLINE = "deadline-expired"
+UPSTREAM_INCOMPLETE = "upstream-incomplete"
+UPSTREAM_TIMEOUT = "upstream-timeout"
+
+
+def host_port(address: str) -> Tuple[str, int]:
+    addr = str(address)
+    if "//" in addr:
+        addr = addr.split("//", 1)[1]
+    addr = addr.rstrip("/")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _body_reason(data: bytes) -> str:
+    """The named reason out of a replica's JSON response body, '' when
+    unparseable (a 503 is a 503 either way)."""
+    try:
+        doc = json.loads(data.decode("utf-8"))
+        return str(doc.get("reason") or "")
+    except (ValueError, AttributeError):
+        return ""
+
+
+class _Attempt(RuntimeError):
+    """One proxy leg's terminal failure, classified for the retry
+    policy: ``retryable`` re-routes to another replica, anything else is
+    the request's final answer."""
+
+    def __init__(self, code: int, reason: str, *, retryable: bool,
+                 replica: str = "", detail: str = ""):
+        super().__init__(f"{reason} (replica {replica or '?'})")
+        self.code = int(code)
+        #: bare reason only — it keys shed counters and Prometheus
+        #: labels, so errno text (unbounded cardinality) rides
+        #: ``detail`` instead
+        self.reason = str(reason)
+        self.retryable = bool(retryable)
+        self.replica = str(replica)
+        self.detail = str(detail)
+
+
+class RouterEngine:
+    """Replica choice + deadline-bounded proxy + retry accounting for
+    one router process.  Transport-free core (the HTTP server below is a
+    thin shell), so the unit tests drive it directly."""
+
+    def __init__(self, view: FleetView, *, retry_budget: int = 2,
+                 leg_grace_s: float = 0.25,
+                 latency_window: int = 2048,
+                 rng: Optional[random.Random] = None):
+        self.view = view
+        self.retry_budget = max(0, int(retry_budget))
+        self.leg_grace_s = float(leg_grace_s)
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self.proxied = 0
+        self.ok = 0
+        self.retries = 0
+        self.shed_counts: Dict[str, int] = {}
+        self.by_code: Dict[int, int] = {}
+        self.by_replica: Dict[str, int] = {}
+        self._latencies_ms: List[float] = []
+        self._latency_window = int(latency_window)
+
+    # -- replica choice ---------------------------------------------------
+
+    @staticmethod
+    def _score(info: ReplicaInfo) -> Tuple[float, int]:
+        # lease-published estimate first; local in-flight count breaks
+        # ties and covers the staleness window between lease rounds
+        return (info.est_delay_s, info.inflight)
+
+    def pick_replica(self, exclude=()) -> Optional[ReplicaInfo]:
+        candidates = [
+            r for r in self.view.balance_set() if r.name not in exclude
+        ]
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = self._rng.sample(candidates, 2)
+        return a if self._score(a) <= self._score(b) else b
+
+    # -- the proxy --------------------------------------------------------
+
+    def handle_infer(self, payload: dict,
+                     deadline: Deadline) -> Tuple[int, dict]:
+        """Route one request; returns ``(http_code, response_json)``.
+        Every terminal outcome is named — the router never raises into
+        its transport."""
+        with self._lock:
+            self.proxied += 1
+        attempted: List[str] = []
+        t0 = time.monotonic()
+
+        def attempt_once():
+            if deadline.exceeded():
+                raise _Attempt(504, SHED_DEADLINE, retryable=False)
+            pick = self.pick_replica(exclude=attempted)
+            if pick is None:
+                raise _Attempt(503, SHED_NO_REPLICA, retryable=False)
+            attempted.append(pick.name)
+            return self._proxy_leg(pick, payload, deadline)
+
+        def on_retry(err, attempt, delay):
+            with self._lock:
+                self.retries += 1
+            logger.warning(
+                f"ROUTER RETRY: {err.reason} on replica {err.replica}; "
+                f"re-routing (attempt {attempt + 1}, "
+                f"budget {self.retry_budget})"
+            )
+            from unicore_tpu import telemetry
+
+            telemetry.emit(
+                "router-retry", reason=err.reason, replica=err.replica,
+                attempt=int(attempt + 1),
+            )
+
+        try:
+            code, body = retry.retry_call(
+                attempt_once,
+                retry.RetryPolicy(
+                    attempts=1 + self.retry_budget,
+                    backoff=0.02, multiplier=2.0, jitter=0.25,
+                    deadline=max(deadline.remaining(), 0.001),
+                ),
+                giveup=lambda err: not getattr(err, "retryable", False),
+                on_retry=on_retry,
+            )
+        except Exception as err:
+            if not isinstance(err, _Attempt):
+                # the router must answer, not raise into its transport
+                logger.exception("router proxy failed unexpectedly")
+                self._count_shed("router-internal-error", 500)
+                return 500, {
+                    "status": "error", "reason": "router-internal-error",
+                    "detail": f"{type(err).__name__}: {err}",
+                }
+            reason = err.reason
+            if err.retryable:
+                # budget (or the deadline) ran out mid-retry: the named
+                # outcome is the router's, the last leg's failure rides
+                # along as detail
+                code, body = 503, {
+                    "status": "shed", "reason": SHED_RETRY_BUDGET,
+                    "last_error": err.reason, "replicas_tried": attempted,
+                }
+                reason = SHED_RETRY_BUDGET
+            else:
+                code = err.code
+                body = {"status": "shed" if code == 503 else "error",
+                        "reason": err.reason}
+                if err.detail:
+                    body["detail"] = err.detail
+                if code == 504:
+                    body["status"] = "expired"
+            self._count_shed(reason, code)
+            return code, body
+        with self._lock:
+            self.by_code[code] = self.by_code.get(code, 0) + 1
+            if code == 200:
+                self.ok += 1
+                self._latencies_ms.append(
+                    (time.monotonic() - t0) * 1000.0
+                )
+                if len(self._latencies_ms) > self._latency_window:
+                    del self._latencies_ms[: self._latency_window // 4]
+        return code, body
+
+    def _proxy_leg(self, info: ReplicaInfo, payload: dict,
+                   deadline: Deadline) -> Tuple[int, dict]:
+        remaining = deadline.remaining()
+        if remaining <= 0:
+            raise _Attempt(504, SHED_DEADLINE, retryable=False)
+        host, port = host_port(info.address)
+        # the leg is bounded by the REQUEST's remaining budget (plus a
+        # grace for the replica's own response marshalling) — a stalled
+        # replica costs one deadline, never a worker forever
+        conn = HTTPConnection(
+            host, port, timeout=remaining + self.leg_grace_s
+        )
+        try:
+            try:
+                conn.connect()
+            except OSError as err:
+                # nothing streamed: safe to re-route
+                self.view.mark_unready(info.name, "connect-failure")
+                raise _Attempt(
+                    502, "connect-failure", retryable=True,
+                    replica=info.name, detail=str(err),
+                ) from None
+            body = json.dumps(
+                # the deadline travels: downstream sees what is LEFT, so
+                # every stage of the replica's admission expires exactly
+                # the requests the client has already given up on
+                {**payload, "deadline_ms": round(remaining * 1000.0, 1)}
+            ).encode("utf-8")
+            self.view.note_dispatch(info.name)
+            try:
+                try:
+                    conn.request(
+                        "POST", "/v1/infer", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    status = resp.status
+                except (socket.timeout, TimeoutError) as err:
+                    # request streamed, response never finished: the
+                    # replica may be computing it (replica-stall zombie)
+                    # — down-mark and answer 504; NEVER retried
+                    self.view.mark_unready(info.name, UPSTREAM_TIMEOUT)
+                    raise _Attempt(
+                        504, UPSTREAM_TIMEOUT, retryable=False,
+                        replica=info.name,
+                    ) from err
+                except (HTTPException, OSError) as err:
+                    # body already streamed (at least partly): the
+                    # replica may have executed the request — a named
+                    # 502, never a recompute on another replica.
+                    # (IncompleteRead/BadStatusLine are HTTPException,
+                    # broken pipes are OSError; same verdict either way)
+                    self.view.mark_unready(info.name, UPSTREAM_INCOMPLETE)
+                    raise _Attempt(
+                        502, UPSTREAM_INCOMPLETE,
+                        retryable=False, replica=info.name,
+                        detail=str(err),
+                    ) from None
+            finally:
+                self.view.note_done(info.name)
+        finally:
+            conn.close()
+        if status == 503:
+            # the replica's /readyz flipped (draining / mid-reload):
+            # leave the balance set NOW, not at the next lease round,
+            # and re-route this request — its body got a complete,
+            # DEFINITIVE "not me" answer, so retrying is safe
+            reason = _body_reason(data) or "not-ready"
+            self.view.mark_unready(info.name, f"503:{reason}")
+            raise _Attempt(
+                503, f"replica-503:{reason}", retryable=True,
+                replica=info.name,
+            )
+        if status in (500, 502):
+            raise _Attempt(
+                status, f"replica-{status}", retryable=True,
+                replica=info.name,
+            )
+        with self._lock:
+            self.by_replica[info.name] = (
+                self.by_replica.get(info.name, 0) + 1
+            )
+        try:
+            doc = json.loads(data.decode("utf-8"))
+        except ValueError:
+            doc = {"status": "error", "reason": "unparseable-upstream",
+                   "replica": info.name}
+        return status, doc
+
+    # -- accounting --------------------------------------------------------
+
+    def _count_shed(self, reason: str, code: int) -> None:
+        with self._lock:
+            self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+            self.by_code[code] = self.by_code.get(code, 0) + 1
+            count = self.shed_counts[reason]
+        logger.warning(f"ROUTER SHED: {reason} #{count} -> {code}")
+        if count <= 5 or count % 100 == 0:
+            from unicore_tpu import telemetry
+
+            telemetry.emit(
+                "router-shed", reason=str(reason), count=int(count),
+                code=int(code),
+            )
+
+    def ready(self) -> bool:
+        return bool(self.view.balance_set())
+
+    def latency_percentiles(self) -> dict:
+        with self._lock:
+            lat = list(self._latencies_ms)
+        if not lat:
+            return {}
+        arr = np.asarray(lat)
+        return {
+            f"p{p}_ms": round(float(np.percentile(arr, p)), 3)
+            for p in (50, 90, 99)
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = {
+                "proxied": self.proxied,
+                "ok": self.ok,
+                "retries": self.retries,
+                "shed": dict(self.shed_counts),
+                "by_code": {str(k): v for k, v in self.by_code.items()},
+                "by_replica": dict(self.by_replica),
+            }
+        return {
+            "ready": self.ready(),
+            **counters,
+            **self.latency_percentiles(),
+            "fleet": self.view.stats(),
+        }

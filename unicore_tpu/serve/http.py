@@ -11,7 +11,15 @@ in the engine/admission layer; this module only maps outcomes onto HTTP:
   (docs/observability.md);
 * ``POST /v1/infer`` → ``{"tokens": [...], "deadline_ms": N, "id": "..."}``
   → 200 ok / 429 shed (named reason) / 503 not-ready-or-draining /
-  504 expired / 408 slow client.
+  504 expired / 408 slow client;
+* ``POST /v1/reload`` (fleet members only) → run this replica's OWN
+  verify→probe→swap on its served checkpoint NOW, answering the named
+  outcome — what the router's rolling reload orchestrates one replica
+  at a time.
+
+Every 503 carries ``Retry-After``: a draining or warming replica's
+refusal is part of the drain/router handshake — the router (and any
+well-behaved client) re-routes or backs off instead of hammering.
 
 Transport robustness: the body read is deadline-bounded (a client that
 trickles its request — chaos ``slow-client`` — gets a 408 instead of
@@ -55,12 +63,20 @@ class ServeHTTPServer(ThreadingHTTPServer):
     def __init__(self, addr, engine, *, read_timeout_s: float = 10.0,
                  max_body_bytes: int = 1 << 20,
                  default_deadline_ms: float = 1000.0,
-                 max_deadline_ms: float = 60000.0):
+                 max_deadline_ms: float = 60000.0,
+                 reloader=None, reload_path: Optional[str] = None):
         self.engine = engine
         self.read_timeout_s = float(read_timeout_s)
         self.max_body_bytes = int(max_body_bytes)
         self.default_deadline_ms = float(default_deadline_ms)
         self.max_deadline_ms = float(max_deadline_ms)
+        #: fleet members expose POST /v1/reload: the router's rolling
+        #: reload asks each replica to run ITS OWN verify→probe→swap —
+        #: one reload at a time per replica (the lock; a second request
+        #: mid-reload answers 409, it must not queue)
+        self.reloader = reloader
+        self.reload_path = reload_path
+        self.reload_lock = threading.Lock()
         super().__init__(addr, ServeHandler)
 
     def start(self) -> threading.Thread:
@@ -73,6 +89,55 @@ class ServeHTTPServer(ThreadingHTTPServer):
 
 class SlowClientError(RuntimeError):
     """The request body did not arrive within the read budget."""
+
+
+def read_bounded_body(handler, *, max_body_bytes: int,
+                      read_timeout_s: float) -> bytes:
+    """Content-Length-framed body read under ONE deadline across chunked
+    reads — the slow-loris discipline BOTH serving transports promise
+    (the replica's handler and the router's share this exact loop so a
+    fix to either can never silently miss the other).  The per-recv
+    socket timeout alone would reset on every trickled byte, letting a
+    slow-loris client hold a worker for hours while never tripping it.
+
+    Raises ``ValueError`` for framing errors (callers map to 400) and
+    :class:`SlowClientError` when the budget expires (callers map to
+    408); both leave the connection marked for close — unread body bytes
+    on a keep-alive stream would desync the next request."""
+    length = int(handler.headers.get("Content-Length") or 0)
+    if length <= 0:
+        handler.close_connection = True  # nothing consumed: don't reuse
+        raise ValueError("missing/empty body (Content-Length required)")
+    if length > max_body_bytes:
+        handler.close_connection = True  # body left unread on the stream
+        raise ValueError(
+            f"body of {length} bytes exceeds the "
+            f"{max_body_bytes}-byte limit"
+        )
+    deadline = time.monotonic() + read_timeout_s
+    buf = bytearray()
+    try:
+        while len(buf) < length:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise SlowClientError(
+                    f"body incomplete ({len(buf)}/{length} bytes) after "
+                    f"{read_timeout_s:g}s"
+                )
+            handler.connection.settimeout(min(left, read_timeout_s))
+            chunk = handler.rfile.read1(length - len(buf))
+            if not chunk:
+                raise ValueError(
+                    f"client closed mid-body ({len(buf)}/{length} bytes)"
+                )
+            buf.extend(chunk)
+    except socket.timeout as err:
+        raise SlowClientError(
+            f"socket read timed out after {read_timeout_s:g}s"
+        ) from err
+    finally:
+        handler.connection.settimeout(read_timeout_s)
+    return bytes(buf)
 
 
 class ServeHandler(BaseHTTPRequestHandler):
@@ -94,6 +159,11 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if code == 503:
+            # drain/router handshake: a draining or warming replica's
+            # refusal names WHEN to come back, so the router re-routes
+            # immediately and clients back off instead of hammering
+            self.send_header("Retry-After", "1")
         self.end_headers()
         self.wfile.write(body)
 
@@ -128,16 +198,6 @@ class ServeHandler(BaseHTTPRequestHandler):
     # -- inference -------------------------------------------------------
 
     def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length") or 0)
-        if length <= 0:
-            self.close_connection = True  # nothing consumed: don't reuse
-            raise ValueError("missing/empty body (Content-Length required)")
-        if length > self.server.max_body_bytes:
-            self.close_connection = True  # body left unread on the stream
-            raise ValueError(
-                f"body of {length} bytes exceeds the "
-                f"{self.server.max_body_bytes}-byte limit"
-            )
         # chaos 'slow-client': the bytes "arrive" only after the injected
         # stall — the bounded wait below must 408 a stall longer than the
         # read budget instead of blocking a worker for the duration
@@ -153,41 +213,31 @@ class ServeHandler(BaseHTTPRequestHandler):
                 )
             except retry.WaitTimeoutError as err:
                 raise SlowClientError(str(err)) from None
-        # ONE deadline for the whole body, enforced across chunked read1
-        # calls (at most one recv each): the per-recv socket timeout alone
-        # would reset on every trickled byte, letting a slow-loris client
-        # hold this worker for hours while never tripping it
-        deadline = time.monotonic() + self.server.read_timeout_s
-        buf = bytearray()
-        try:
-            while len(buf) < length:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise SlowClientError(
-                        f"body incomplete ({len(buf)}/{length} bytes) after "
-                        f"{self.server.read_timeout_s:g}s"
-                    )
-                self.connection.settimeout(min(left, self.server.read_timeout_s))
-                chunk = self.rfile.read1(length - len(buf))
-                if not chunk:
-                    raise ValueError(
-                        f"client closed mid-body ({len(buf)}/{length} bytes)"
-                    )
-                buf.extend(chunk)
-        except socket.timeout as err:
-            raise SlowClientError(
-                f"socket read timed out after "
-                f"{self.server.read_timeout_s:g}s"
-            ) from err
-        finally:
-            self.connection.settimeout(self.server.read_timeout_s)
-        return bytes(buf)
+        return read_bounded_body(
+            self,
+            max_body_bytes=self.server.max_body_bytes,
+            read_timeout_s=self.server.read_timeout_s,
+        )
 
     def do_POST(self):
+        if self.path == "/v1/reload":
+            self._handle_reload()
+            return
         if self.path != "/v1/infer":
             self._send_json(404, {"error": f"unknown path {self.path}"})
             return
         server = self.server
+        # chaos 'replica-stall': wedge the inference plane while the
+        # lease publisher keeps beating — the zombie replica.  The wait
+        # is sliced so a closed stall window releases the worker.
+        if chaos.replica_stall_active():
+            logger.warning(
+                "chaos: replica-stall — /v1/infer handler WEDGED (lease "
+                "stays healthy; the router's deadline-bounded proxy leg "
+                "must shed around this replica)"
+            )
+            while chaos.replica_stall_active():
+                time.sleep(0.1)
         try:
             body = self._read_body()
             payload = json.loads(body.decode("utf-8"))
@@ -272,6 +322,48 @@ class ServeHandler(BaseHTTPRequestHandler):
         else:
             code = 500
         self._send_json(code, resp.to_json())
+
+
+    # -- fleet rolling reload --------------------------------------------
+
+    def _handle_reload(self):
+        """One synchronous verify→probe→swap on THIS replica's served
+        checkpoint, answered with the named outcome.  The router's
+        rolling reload calls this one replica at a time; readiness flips
+        false for the duration (HotReloader's own behavior), so the
+        router routes around the replica mid-swap."""
+        server = self.server
+        if server.reloader is None or server.reload_path is None:
+            self._send_json(
+                404, {"error": "this replica is not fleet-reloadable "
+                               "(start it with --advertise)"},
+            )
+            return
+        try:
+            # body is advisory (the replica reloads its OWN path — a
+            # router must not be able to point it at arbitrary files);
+            # read it to keep the connection in sync
+            self._read_body()
+        except (SlowClientError, ValueError):
+            pass
+        if not server.reload_lock.acquire(blocking=False):
+            self._send_json(
+                409, {"outcome": "reload-in-progress",
+                      "error": "another reload is mid-flight"},
+            )
+            return
+        try:
+            outcome = server.reloader.consider(server.reload_path)
+        except Exception as err:  # the reload plane must answer, not raise
+            logger.exception("fleet reload request failed")
+            self._send_json(
+                500, {"outcome": "error",
+                      "error": f"{type(err).__name__}: {err}"},
+            )
+            return
+        finally:
+            server.reload_lock.release()
+        self._send_json(200, {"outcome": outcome})
 
 
 def bind_server(host: str, port: int, engine, **kw) -> ServeHTTPServer:

@@ -323,6 +323,68 @@ def summarize(merged: List[Dict[str, Any]]) -> List[str]:
             "membership epochs seen: "
             + " -> ".join(str(e) for e in epochs)
         )
+    # fleet post-mortem: which replica died, when the router noticed,
+    # what got shed in the gap, how far a rolling reload got.  Router
+    # journals are anchorless (no trainer updates), so times are the
+    # raw-wall offsets from the merged timeline's start.
+    t0 = merged[0]["_t"] if merged else 0.0
+    for rec in by_kind.get("fleet-verdict", ()):
+        verdict = str(rec.get("verdict", "?"))
+        if verdict == "control-plane-freeze":
+            lines.append(
+                f"fleet membership FROZEN at +{rec['_t'] - t0:.3f}s "
+                "(KV outage: verdicts freeze, they are never minted "
+                "from service silence)"
+            )
+            continue
+        who = rec.get("replica", "?")
+        detail = rec.get("message") or rec.get("reason", "")
+        lines.append(
+            f"replica {who} {verdict.upper()} noticed by the router at "
+            f"+{rec['_t'] - t0:.3f}s: {detail}"
+        )
+    retries = by_kind.get("router-retry", ())
+    if retries:
+        per: Dict[str, int] = defaultdict(int)
+        for rec in retries:
+            per[str(rec.get("reason", "?"))] += 1
+        lines.append(
+            "router retries: "
+            + ", ".join(f"{r} x{per[r]}" for r in sorted(per))
+        )
+    rsheds = by_kind.get("router-shed", ())
+    if rsheds:
+        rmax: Dict[str, int] = defaultdict(int)
+        rseen: Dict[str, int] = defaultdict(int)
+        for rec in rsheds:
+            reason = str(rec.get("reason", "?"))
+            rseen[reason] += 1
+            try:
+                rmax[reason] = max(rmax[reason], int(rec.get("count", 0)))
+            except (TypeError, ValueError):
+                pass
+        lines.append(
+            "router sheds: "
+            + ", ".join(
+                f"{r} x{max(rmax[r], rseen[r])}" for r in sorted(rmax | rseen)
+            )
+        )
+    for rec in by_kind.get("fleet-reload", ()):
+        event = rec.get("event")
+        if event == "halt":
+            lines.append(
+                f"ROLLING RELOAD HALTED at +{rec['_t'] - t0:.3f}s: replica "
+                f"{rec.get('replica', '?')} answered "
+                f"'{rec.get('outcome', '?')}' — "
+                f"{rec.get('never_asked', '?')} replica(s) never asked, "
+                "fleet kept the old snapshot"
+            )
+        elif event == "complete":
+            lines.append(
+                f"rolling reload complete at +{rec['_t'] - t0:.3f}s: "
+                f"{rec.get('swapped', '?')} replica(s) swapped to "
+                f"{rec.get('path', '?')}"
+            )
     sheds = by_kind.get("serve-shed", ())
     if sheds:
         # shed journaling is SAMPLED past 5/reason (a flood must not make

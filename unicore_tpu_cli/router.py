@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""``unicore-tpu-router``: the fleet entry point.
+
+Boot sequence (documented failure exit codes, same discipline as the
+serve CLI's 75-77 and training's 65-74 — docs/robustness.md):
+
+1. open the fleet KV root from ``--fleet-kv`` (exit **78** on an
+   unusable root: there is no fleet to route);
+2. HTTP bind on ``--host:--port`` (exit **75** on failure) — probes go
+   live immediately; readiness tracks "≥1 routable replica";
+3. start the membership lease rounds (replicas appear as they
+   ``--advertise``; silence ripens into named replica-loss verdicts,
+   a KV outage freezes the verdict plane instead);
+4. optionally arm ROLLING fleet reload (``--path`` +
+   ``--reload-interval``): one replica at a time, halt on the first
+   ``RELOAD ROLLBACK`` — a bad checkpoint's blast radius is one
+   replica;
+5. route until signalled: SIGTERM/SIGINT stops accepting, logs final
+   stats, exit **0**.  The router holds NO queue — in-flight proxy legs
+   are deadline-bounded and finish on their own budgets.
+
+The router is deliberately model-free: it never loads a checkpoint,
+never imports jax, and restarts in milliseconds — replicas are the
+stateful tier, the router is disposable.
+"""
+
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+_LOG_FIELDS = ("asctime", "levelname", "name", "message")
+logging.basicConfig(
+    stream=sys.stdout,
+    level=os.environ.get("LOGLEVEL", "INFO").upper(),
+    format=" | ".join(f"%({f})s" for f in _LOG_FIELDS),
+    datefmt="%Y-%m-%d %H:%M:%S",
+)
+logger = logging.getLogger("unicore_tpu_cli.router")
+
+EXIT_OK = 0
+EXIT_ROUTER_BIND = 75        # same meaning as the serve CLI's bind failure
+EXIT_ROUTER_FLEET_KV = 78    # --fleet-kv root unusable at startup
+
+ROUTER_EXIT_CODE_NAMES = {
+    EXIT_OK: "ok",
+    EXIT_ROUTER_BIND: "router-bind-failure",
+    EXIT_ROUTER_FLEET_KV: "router-fleet-kv-failure",
+}
+
+_stop_requested = threading.Event()
+
+
+def _handle_signal(signum, frame):
+    name = signal.Signals(signum).name
+    logger.warning(
+        f"received {name}: router stopping (in-flight proxy legs finish "
+        "on their own deadlines; no queue to drain)"
+    )
+    _stop_requested.set()
+
+
+def main(args) -> int:
+    from unicore_tpu import telemetry
+    from unicore_tpu.distributed import chaos
+    from unicore_tpu.serve.fleet import (
+        FleetKVError,
+        FleetView,
+        MembershipRunner,
+        RollingReload,
+        RouterEngine,
+        bind_router,
+        open_fleet_kv,
+    )
+    from unicore_tpu.serve.reload import CheckpointWatcher
+
+    chaos.configure(args)
+    logger.info(args)
+
+    # router event journal: default beside the fleet KV so replicas
+    # pointed at the same --telemetry-dir merge into one fleet timeline
+    if not getattr(args, "telemetry_dir", None):
+        args.telemetry_dir = os.path.join(
+            os.path.abspath(args.fleet_kv), "telemetry"
+        )
+    telemetry.configure(args, rank=0, role="router")
+
+    # 1. fleet KV ------------------------------------------------------------
+    try:
+        client = open_fleet_kv(args.fleet_kv)
+    except FleetKVError as err:
+        logger.error(
+            f"FATAL: {err} — exiting {EXIT_ROUTER_FLEET_KV} "
+            f"({ROUTER_EXIT_CODE_NAMES[EXIT_ROUTER_FLEET_KV]})"
+        )
+        return EXIT_ROUTER_FLEET_KV
+
+    view = FleetView(client, timeout=args.fleet_timeout)
+    engine = RouterEngine(view, retry_budget=args.retry_budget)
+
+    # 2. bind ----------------------------------------------------------------
+    try:
+        server = bind_router(
+            args.host, args.port, engine,
+            read_timeout_s=args.request_read_timeout,
+            default_deadline_ms=args.default_deadline_ms,
+            max_deadline_ms=args.max_deadline_ms,
+        )
+    except OSError as err:
+        logger.error(
+            f"FATAL: cannot bind {args.host}:{args.port} ({err}) — "
+            f"exiting {EXIT_ROUTER_BIND} "
+            f"({ROUTER_EXIT_CODE_NAMES[EXIT_ROUTER_BIND]})"
+        )
+        return EXIT_ROUTER_BIND
+    server.start()
+
+    # 3. membership ----------------------------------------------------------
+    membership = MembershipRunner(view, args.fleet_interval).start()
+    telemetry.emit(
+        "router-start", fleet_kv=os.path.abspath(args.fleet_kv),
+        fleet_timeout=float(args.fleet_timeout),
+        retry_budget=int(args.retry_budget),
+    )
+
+    # 4. rolling reload ------------------------------------------------------
+    rolling = None
+    if args.reload_interval > 0:
+        if not args.path:
+            logger.warning(
+                "--reload-interval without --path: nothing to watch; "
+                "rolling reload disarmed"
+            )
+        else:
+            rolling = RollingReload(
+                CheckpointWatcher(args.path), view,
+                interval_s=args.reload_interval,
+                reload_timeout_s=args.reload_timeout,
+            ).start()
+
+    # 5. route ---------------------------------------------------------------
+    started = time.monotonic()
+    while not _stop_requested.is_set():
+        if (
+            args.max_seconds > 0
+            and time.monotonic() - started >= args.max_seconds
+        ):
+            logger.info(
+                f"--max-seconds ({args.max_seconds:g}s) reached: stopping"
+            )
+            break
+        _stop_requested.wait(timeout=0.2)
+
+    if rolling is not None:
+        rolling.stop()
+    membership.stop()
+    server.shutdown()
+    logger.info(f"final router stats: {engine.stats()}")
+    logger.info("router shutdown clean, exiting 0")
+    return EXIT_OK
+
+
+def cli_main() -> None:
+    from unicore_tpu import options
+
+    parser = options.get_router_parser()
+    args = parser.parse_args()
+
+    try:
+        signal.signal(signal.SIGTERM, _handle_signal)
+        signal.signal(signal.SIGINT, _handle_signal)
+    except ValueError:
+        logger.warning(
+            "could not install signal handlers (not the main thread)"
+        )
+
+    sys.exit(main(args))
+
+
+if __name__ == "__main__":
+    cli_main()

@@ -22,7 +22,13 @@ rollback); ``--fault-inject`` arms the serving chaos kinds;
 ``--serve-quantize {int8,fp8}`` inserts a calibration pass before warm-up
 and serves the quantized per-bucket programs (dequant fused into the
 consuming ops; reload re-verifies scales and rolls back
-``rejected:calibration`` on mismatch).  See docs/serving.md.
+``rejected:calibration`` on mismatch).  ``--advertise`` +
+``--fleet-kv`` joins a serving fleet: the replica self-registers
+through a serve-namespaced heartbeat lease (address, readiness,
+snapshot digest, /stats admission estimate), flips its lease ready
+false the moment a drain begins, says a deregistration goodbye on
+clean exit, and exposes ``POST /v1/reload`` for the router's rolling
+reload.  See docs/serving.md.
 """
 
 import logging
@@ -47,12 +53,14 @@ EXIT_OK = 0
 EXIT_SERVE_BIND = 75            # HTTP bind/port failure at startup
 EXIT_SERVE_MODEL_LOAD = 76      # model load / warm-up failure at startup
 EXIT_SERVE_DRAIN_DEADLINE = 77  # drain budget exceeded (or forced abort)
+EXIT_SERVE_FLEET_KV = 78        # --advertise with an unusable --fleet-kv
 
 SERVE_EXIT_CODE_NAMES = {
     EXIT_OK: "ok",
     EXIT_SERVE_BIND: "serve-bind-failure",
     EXIT_SERVE_MODEL_LOAD: "serve-model-load-failure",
     EXIT_SERVE_DRAIN_DEADLINE: "serve-drain-deadline-exceeded",
+    EXIT_SERVE_FLEET_KV: "fleet-kv-failure",
 }
 
 # signal plumbing: first signal requests a drain, the second aborts
@@ -337,6 +345,55 @@ def build_engine(args, model, variables, pad_idx, max_seq_len,
     )
 
 
+def start_fleet_registration(args, server, engine):
+    """``--advertise``: self-register this replica through the fleet's
+    serve-namespaced heartbeat lease plane (docs/serving.md 'Fleet').
+    Raises on config/root trouble — the caller maps it to exit 78."""
+    from unicore_tpu.serve import fleet
+
+    if not getattr(args, "fleet_kv", None):
+        raise ValueError(
+            "--advertise requires --fleet-kv DIR (the coordination "
+            "store the router reads membership from)"
+        )
+    client = fleet.open_fleet_kv(args.fleet_kv)
+    name = args.replica_name or f"r{args.replica_index}"
+    address = args.advertise
+    if address == "auto":
+        host = (
+            args.host if args.host not in ("0.0.0.0", "::") else "127.0.0.1"
+        )
+        address = f"http://{host}:{server.server_address[1]}"
+    from unicore_tpu.serve.fleet.router import host_port
+
+    try:
+        host_port(address)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"--advertise {address!r} is not a routable address: the "
+            "router dials it, so it must carry host:port (or use 'auto')"
+        ) from None
+    # the lease's snapshot digest tracks hot swaps: chain onto the
+    # engine's swap hook (the quant CLI may already own one)
+    digest_cell = {"d": fleet.model_digest(engine.variables)}
+    prev_hook = engine._swap_hook
+
+    def swap_hook(new_vars, tag):
+        if prev_hook is not None:
+            prev_hook(new_vars, tag)
+        digest_cell["d"] = fleet.model_digest(new_vars)
+
+    engine._swap_hook = swap_hook
+    return fleet.ReplicaRegistrar(
+        client, name, address,
+        interval_s=args.fleet_interval,
+        ready_fn=engine.ready,
+        est_delay_fn=engine.queue.estimated_delay,
+        digest_fn=lambda: digest_cell["d"],
+        served_fn=lambda: engine.served,
+    ).start()
+
+
 def _start_flood_generator(args, engine, stop_event: threading.Event):
     """Synthetic traffic driver for the ``request-flood`` chaos kind:
     offers chaos.serve_flood_qps() requests per second straight into
@@ -386,17 +443,25 @@ def main(args) -> int:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     chaos.configure(args)
+    # which fleet replica this process is — the @IDX target of the
+    # replica-loss / replica-stall chaos kinds
+    chaos.set_replica_index(getattr(args, "replica_index", 0) or 0)
     logger.info(args)
 
     # serve-plane event journal (docs/observability.md): sheds, reload
-    # outcomes, drains — default location is beside the served checkpoint
+    # outcomes, drains — default location is beside the served
+    # checkpoint.  Fleet replicas journal under their replica index so N
+    # replicas sharing one --telemetry-dir write N distinct files the
+    # trace merger joins.
     from unicore_tpu import telemetry
 
     if not getattr(args, "telemetry_dir", None):
         args.telemetry_dir = os.path.join(
             os.path.dirname(os.path.abspath(args.path)) or ".", "telemetry"
         )
-    telemetry.configure(args, rank=0, role="serve")
+    telemetry.configure(
+        args, rank=getattr(args, "replica_index", 0) or 0, role="serve"
+    )
 
     # 1. verified model load (+ calibration when quantizing) -----------------
     try:
@@ -446,6 +511,23 @@ def main(args) -> int:
         return EXIT_SERVE_BIND
     server.start()
 
+    # fleet membership: self-register BEFORE warm-up so the router's
+    # view shows the replica registered-but-not-ready while its bucket
+    # programs compile (the lease carries readiness truthfully)
+    registrar = None
+    if getattr(args, "advertise", None):
+        try:
+            registrar = start_fleet_registration(args, server, engine)
+        except Exception as err:
+            logger.error(
+                f"FATAL: fleet registration failed "
+                f"({type(err).__name__}: {err}) — exiting "
+                f"{EXIT_SERVE_FLEET_KV} "
+                f"({SERVE_EXIT_CODE_NAMES[EXIT_SERVE_FLEET_KV]})"
+            )
+            server.shutdown()
+            return EXIT_SERVE_FLEET_KV
+
     # 3. warm-up (readiness flips true inside) -------------------------------
     try:
         engine.warmup()
@@ -456,34 +538,46 @@ def main(args) -> int:
             f"({SERVE_EXIT_CODE_NAMES[EXIT_SERVE_MODEL_LOAD]})",
             exc_info=True,
         )
+        if registrar is not None:
+            registrar.stop(goodbye=True)
         server.shutdown()
         return EXIT_SERVE_MODEL_LOAD
+    if registrar is not None:
+        registrar.publish_now()  # readiness flipped: don't wait the beat
 
     # 4. serve ---------------------------------------------------------------
     engine.start()
 
+    hot_reloader = None
+    if args.reload_interval > 0 or registrar is not None:
+        from unicore_tpu import checkpoint_utils
+        from unicore_tpu.serve import HotReloader
+
+        hot_reloader = HotReloader(
+            engine, checkpoint_utils.load_checkpoint_to_cpu,
+            # quantized serving: candidates re-verify/re-derive scales
+            # (rejected:calibration on failure) and the structure
+            # check runs against the fp32 tree — the engine's live
+            # tree is the PREPARED one
+            preparer=preparer,
+            preparer_abort=preparer_abort,
+            structure_ref=variables if preparer is not None else None,
+        )
     reload_runner = None
     if args.reload_interval > 0:
-        from unicore_tpu import checkpoint_utils
-        from unicore_tpu.serve import (
-            CheckpointWatcher, HotReloader, ReloadRunner,
-        )
+        from unicore_tpu.serve import CheckpointWatcher, ReloadRunner
 
         reload_runner = ReloadRunner(
-            CheckpointWatcher(args.path),
-            HotReloader(
-                engine, checkpoint_utils.load_checkpoint_to_cpu,
-                # quantized serving: candidates re-verify/re-derive scales
-                # (rejected:calibration on failure) and the structure
-                # check runs against the fp32 tree — the engine's live
-                # tree is the PREPARED one
-                preparer=preparer,
-                preparer_abort=preparer_abort,
-                structure_ref=variables if preparer is not None else None,
-            ),
+            CheckpointWatcher(args.path), hot_reloader,
             args.reload_interval,
         )
         reload_runner.start()
+    if registrar is not None:
+        # the router's ROLLING reload drives this replica's own
+        # verify→probe→swap through POST /v1/reload (always on the
+        # replica's OWN --path; the router cannot point it elsewhere)
+        server.reloader = hot_reloader
+        server.reload_path = args.path
 
     flood_stop = threading.Event()
     flood_thread = _start_flood_generator(args, engine, flood_stop)
@@ -502,6 +596,10 @@ def main(args) -> int:
             flood_stop.set()
             if reload_runner is not None:
                 reload_runner.stop()
+            if registrar is not None:
+                # deregister (goodbye) rather than rot: the router drops
+                # this replica NOW instead of waiting a loss verdict
+                registrar.stop(goodbye=True)
             server.shutdown()
             return 1
         if (
@@ -523,9 +621,21 @@ def main(args) -> int:
     flood_stop.set()
     if reload_runner is not None:
         reload_runner.stop()
+    if registrar is not None:
+        # drain/router handshake: flip the lease ready=false BEFORE the
+        # flush, so the router stops routing here within one beat (its
+        # data path also reacts to the first 503 immediately)
+        from unicore_tpu.serve.engine import PHASE_DRAINING
+
+        engine.set_ready(False, PHASE_DRAINING)
+        registrar.publish_now()
     deadline = Deadline(args.drain_deadline)
     with deadline_scope(deadline):
         drained = engine.drain(deadline)
+    if registrar is not None:
+        # clean exit says goodbye: the router DEREGISTERS this replica
+        # (no loss verdict) instead of expiring its lease
+        registrar.stop(goodbye=True)
     server.shutdown()
     flood_thread.join(timeout=2.0)
     logger.info(f"final serve stats: {engine.stats()}")
