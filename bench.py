@@ -432,11 +432,44 @@ def _telemetry_identity():
         return "", ""
 
 
+_DEVICE_KIND_MEMO = None
+
+
+def _device_kind():
+    """Memoized ``jax.devices()[0].device_kind`` ('' on failure).  By the
+    time any bench row exists the backend is necessarily up (the bench
+    just ran on it), so attaching the label BEFORE the single append can
+    never hang — the old attach-after-append dance double-appended every
+    serve/fleet row (one line without device_kind, one with)."""
+    global _DEVICE_KIND_MEMO
+    if _DEVICE_KIND_MEMO is None:
+        try:
+            import jax
+
+            _DEVICE_KIND_MEMO = jax.devices()[0].device_kind
+        except Exception as e:
+            sys.stderr.write(f"bench: device kind lookup failed: {e!r}\n")
+            _DEVICE_KIND_MEMO = ""
+    return _DEVICE_KIND_MEMO
+
+
+def _label_row(row):
+    """Attach the cpu_fallback / device_kind diagnostics in place (shared
+    by every config so the labeling can't drift per bench)."""
+    if os.environ.get("BENCH_CPU_FALLBACK"):
+        row["cpu_fallback"] = True
+    kind = _device_kind()
+    if kind:
+        row["device_kind"] = kind
+    return row
+
+
 def _append_partial(result):
     """Append the result line to BENCH_PARTIAL.jsonl immediately — a hang in
     a later config must not lose an earlier config's number.  Lines carry a
-    per-invocation run id; readers take the LAST line for a (run, metric)
-    pair (results are re-appended once diagnostics are attached)."""
+    per-invocation run id; each (run, metric) pair appends exactly ONCE,
+    fully labeled (device_kind is memoized up front, so attaching it can't
+    hang and no provisional duplicate line is needed)."""
     try:
         line = dict(result)
         line["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
@@ -630,14 +663,7 @@ def run_serve_bench():
     for k in ("p50_ms", "p90_ms", "p99_ms"):
         if k in stats:
             result[k] = stats[k]
-    _append_partial(result)  # raw number first — diagnostics can hang
-    if os.environ.get("BENCH_CPU_FALLBACK"):
-        result["cpu_fallback"] = True
-    try:
-        result["device_kind"] = jax.devices()[0].device_kind
-    except Exception as e:
-        sys.stderr.write(f"bench: diagnostics failed (result kept): {e!r}\n")
-    _append_partial(result)
+    _append_partial(_label_row(result))
     return result
 
 
@@ -767,16 +793,7 @@ def run_serve_quant_bench():
         if arm_qinfo is not None:
             row["quant_rel_drift"] = round(arm_qinfo["rel_drift"], 6)
             row["quant_sites"] = arm_qinfo["sites"]
-        _append_partial(row)  # raw number first — diagnostics can hang
-        if os.environ.get("BENCH_CPU_FALLBACK"):
-            row["cpu_fallback"] = True
-        try:
-            row["device_kind"] = jax.devices()[0].device_kind
-        except Exception as e:
-            sys.stderr.write(
-                f"bench: diagnostics failed (result kept): {e!r}\n"
-            )
-        _append_partial(row)
+        _append_partial(_label_row(row))
         print(json.dumps(row), flush=True)
         last = row
     return last
@@ -930,16 +947,7 @@ def run_fleet_bench():
             for k in ("p50_ms", "p90_ms", "p99_ms"):
                 if k in stats:
                     row[k] = stats[k]
-            _append_partial(row)  # raw number first — diagnostics can hang
-            if os.environ.get("BENCH_CPU_FALLBACK"):
-                row["cpu_fallback"] = True
-            try:
-                row["device_kind"] = jax.devices()[0].device_kind
-            except Exception as e:
-                sys.stderr.write(
-                    f"bench: diagnostics failed (result kept): {e!r}\n"
-                )
-            _append_partial(row)
+            _append_partial(_label_row(row))
             print(json.dumps(row), flush=True)
             last = row
     return last
@@ -980,13 +988,7 @@ def _kernel_row(metric, jnp_ms, fused_ms, extra=None):
     }
     if extra:
         row.update(extra)
-    try:
-        row["device_kind"] = jax.devices()[0].device_kind
-    except Exception as e:
-        sys.stderr.write(f"bench: device kind lookup failed: {e!r}\n")
-    if os.environ.get("BENCH_CPU_FALLBACK"):
-        row["cpu_fallback"] = True
-    _append_partial(row)
+    _append_partial(_label_row(row))
     print(json.dumps(row), flush=True)
     return row
 
@@ -1396,6 +1398,97 @@ def run_memory_bench():
     return rows[-1]
 
 
+# ---------------------------------------------------------------------------
+# hierarchical gradient reduction (BENCH_CONFIG=hierarchy): flat vs two-level
+# ---------------------------------------------------------------------------
+
+def run_hierarchy_bench():
+    """Flat all-reduce vs the two-level path (sum / adasum) over a
+    realistic flat-buffer size on a 2-pod mesh across the visible devices
+    (docs/PARALLELISM.md, 'The plan').  Two numbers per arm: wall ms per
+    reduction call, and the fusion-audit comm section's per-tier operand
+    bytes — the bytes are the PORTABLE claim (cross-tier reduction bytes
+    = 1/pod_size of the flat-buffer bytes), the CPU wall time is a
+    liveness harness, never a perf claim (device_kind labels it)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from unicore_tpu.analysis import fusion_audit as FA
+    from unicore_tpu.parallel import DATA_AXIS, POD_AXIS, make_mesh
+    from unicore_tpu.parallel import hierarchy as H
+    from unicore_tpu.parallel.compat import shard_map
+
+    n = jax.device_count()
+    if n < 2 or n % 2:
+        raise RuntimeError(
+            f"hierarchy bench needs an even device count >= 2 (got {n}); "
+            "on CPU set UNICORE_TPU_PLATFORM=cpu UNICORE_TPU_CPU_DEVICES=8"
+        )
+    pods, pod_size = 2, n // 2
+    mb = float(os.environ.get("BENCH_HIER_MB", "16"))
+    length = int(mb * 1024 ** 2) // 4
+    length -= length % max(1, pod_size)
+    mesh = make_mesh(pods=pods, data=pod_size)
+    spec = P((POD_AXIS, DATA_AXIS))
+
+    def build(mode, deterministic):
+        if mode == "flat":
+            def body(xs):
+                return jax.lax.psum(xs[0], (POD_AXIS, DATA_AXIS))
+        else:
+            def body(xs):
+                (out,) = H.two_level_reduce(
+                    [xs[0]], n_pods=pods, pod_size=pod_size, mode=mode,
+                    deterministic=deterministic,
+                )
+                return out
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=P(),
+            check_vma=False,  # lint: replicated-by-collectives
+        ))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, length).astype(np.float32)
+    flat_bytes = length * 4
+    last = None
+    arms = [
+        ("flat", "flat", False),
+        ("two_level_sum", "sum", False),
+        ("two_level_sum_det", "sum", True),
+        ("two_level_adasum", "adasum", False),
+    ]
+    for name, mode, det in arms:
+        # ONE compile per arm: the audited program is byte-identical to
+        # the timed one (lower().compile() would otherwise build a
+        # second executable beside the jit cache's)
+        compiled = build(mode, det).lower(x).compile()
+        ms = _time_fn(compiled, x)
+        comm = FA.audit_compiled(compiled, devices_per_pod=pod_size)["comm"]
+        dcn = comm["tiers"].get("dcn", {})
+        row = {
+            "metric": f"hierarchy_reduce_{name}_ms",
+            "value": round(ms, 3),
+            "unit": "ms/call",
+            "vs_baseline": None,
+            "combine": mode,
+            "deterministic": det,
+            "pods": pods,
+            "pod_size": pod_size,
+            "buffer_bytes": flat_bytes,
+            "collectives": comm["collectives"],
+            "dcn_ops": dcn.get("ops", 0),
+            "dcn_operand_bytes": dcn.get("operand_bytes", 0),
+            "dcn_bytes_vs_flat": (
+                round(dcn.get("operand_bytes", 0) / flat_bytes, 4)
+                if flat_bytes else None
+            ),
+        }
+        _append_partial(_label_row(row))
+        print(json.dumps(row), flush=True)
+        last = row
+    return last
+
+
 def main():
     _backend_watchdog()
     if os.environ.get("BENCH_PIPELINE", "") not in ("", "0", "false"):
@@ -1417,6 +1510,8 @@ def main():
                 runner = run_fleet_bench
             elif c == "kernels":
                 runner = run_kernel_bench
+            elif c == "hierarchy":
+                runner = run_hierarchy_bench
             elif c == "memory":
                 runner = run_memory_bench
             else:

@@ -475,6 +475,60 @@ def test_balance_by_estimate_power_of_two(tmp_path):
         slow.close()
 
 
+def test_p2c_spreads_under_equal_estimates(tmp_path):
+    """Tied (or stale-identical) admission estimates must NOT collapse
+    p2c onto one replica (the PR-13 bench regression: by_replica
+    {"b0": 285} at n=2): equal scores are a jittered coin flip, so both
+    replicas carry a meaningful share."""
+    view, router = make_view_and_router(
+        tmp_path,
+        [("b0", "http://127.0.0.1:1", 0.0),
+         ("b1", "http://127.0.0.1:2", 0.0)],
+    )
+    counts = {"b0": 0, "b1": 0}
+    for _ in range(300):
+        counts[router.pick_replica().name] += 1
+    assert min(counts.values()) >= 90, counts
+
+
+def test_p2c_inflight_cost_breaks_stale_strict_order(tmp_path):
+    """A slightly-lower STALE estimate must not win every pick: under
+    load the router's own fresh in-flight count costs the favored
+    replica forward until the pair spreads (the estimate itself only
+    refreshes at the next lease round, which never comes here)."""
+    view, router = make_view_and_router(
+        tmp_path,
+        [("b0", "http://127.0.0.1:1", 0.010),
+         ("b1", "http://127.0.0.1:2", 0.012)],
+    )
+    counts = {"b0": 0, "b1": 0}
+    # concurrent-load shape: dispatches outstanding, none completing
+    for _ in range(40):
+        pick = router.pick_replica()
+        counts[pick.name] += 1
+        view.note_dispatch(pick.name)
+    # b0 wins the first pick; its growing in-flight cost then pushes its
+    # score past b1's and the stream alternates
+    assert counts["b0"] >= 1 and counts["b1"] >= 15, counts
+
+
+def test_p2c_three_replicas_no_starvation_under_load(tmp_path):
+    """n=3 regression shape (bench showed zero traffic to one replica):
+    with equal estimates and live inflight accounting every replica gets
+    a share."""
+    view, router = make_view_and_router(
+        tmp_path,
+        [(f"b{i}", f"http://127.0.0.1:{i + 1}", 0.0) for i in range(3)],
+    )
+    counts = {f"b{i}": 0 for i in range(3)}
+    for _ in range(300):
+        pick = router.pick_replica()
+        counts[pick.name] += 1
+        view.note_dispatch(pick.name)
+        view.note_done(pick.name)
+    assert min(counts.values()) >= 50, counts
+
+
 def test_router_rewrites_deadline_to_remaining_budget(tmp_path):
     r = FakeReplica("r0")
     try:

@@ -68,6 +68,89 @@ def test_audit_canned_hlo_counts():
 def test_audit_tolerates_garbage():
     assert fa.audit_hlo("")["kernels"] == 0
     assert fa.audit_hlo("not hlo at all\n{}\n")["fusions"] == 0
+    assert fa.audit_hlo("")["comm"]["collectives"] == 0
+
+
+_CANNED_COMM = """\
+HloModule jit_reduce
+
+%region_0.4 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add.0 = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (x: f32[4096]) -> f32[4096] {
+  %x = f32[4096]{0} parameter(0)
+  %reduce-scatter.1 = f32[2048]{0} reduce-scatter(f32[4096]{0} %x), channel_id=1, replica_groups={{0,1},{2,3}}, use_global_device_ids=true, dimensions={0}, to_apply=%region_0.4
+  %all-reduce.1 = f32[2048]{0} all-reduce(f32[2048]{0} %reduce-scatter.1), channel_id=2, replica_groups={{0,2},{1,3}}, use_global_device_ids=true, to_apply=%region_0.4
+  %cp = f32[2048]{0} collective-permute(f32[2048]{0} %all-reduce.1), channel_id=4, source_target_pairs={{0,1},{1,0}}
+  ROOT %all-gather.1 = f32[4096]{0} all-gather(f32[2048]{0} %cp), channel_id=3, replica_groups={{0,1},{2,3}}, dimensions={0}, use_global_device_ids=true
+}
+"""
+
+
+def test_comm_section_counts_and_tiers():
+    """comm section: per-op counts, operand/result bytes, and the
+    ici/dcn tier split keyed on whether a replica group spans pods
+    (devices_per_pod=2: {0,1} is one pod, {0,2} crosses)."""
+    report = fa.audit_hlo(_CANNED_COMM, devices_per_pod=2)
+    comm = report["comm"]
+    assert comm["collectives"] == 4
+    assert comm["by_op"] == {
+        "reduce-scatter": 1, "all-reduce": 1, "all-gather": 1,
+        "collective-permute": 1,
+    }
+    tiers = comm["tiers"]
+    # reduce-scatter (16384 in) + all-gather (8192 in) + the in-pod
+    # collective-permute (8192 in) stay on ICI; the all-reduce crosses
+    assert tiers["ici"]["ops"] == 3
+    assert tiers["ici"]["operand_bytes"] == 16384 + 8192 + 8192
+    assert tiers["dcn"] == {
+        "ops": 1, "operand_bytes": 8192, "result_bytes": 8192,
+    }
+    assert comm["top"][0]["op"] == "reduce-scatter"
+    assert comm["top"][0]["operand_bytes"] == 16384
+    assert comm["top"][0]["result_bytes"] == 8192
+
+
+def test_comm_section_async_start_and_multi_operand():
+    """Async '-start' collectives carry a TUPLE result shape before the
+    opcode — operand bytes must come from the operand list after the
+    opcode's '(', never the result tuple; multi-operand reduces sum
+    their operands."""
+    hlo = (
+        "HloModule jit_async\n\n"
+        "ENTRY %main (x: f32[1024]) -> f32[1024] {\n"
+        "  %x = f32[1024]{0} parameter(0)\n"
+        "  %s = (f32[1024]{0}, f32[1024]{0}) all-reduce-start("
+        "f32[1024]{0} %x), channel_id=1, replica_groups={{0,1}}, "
+        "to_apply=%r\n"
+        "  %t = f32[4]{0} all-reduce(f32[4]{0} %x, f32[4]{0} %x, "
+        "f32[4]{0} %x), channel_id=2, replica_groups={{0,2}}, "
+        "to_apply=%r\n"
+        "  ROOT %d = f32[1024]{0} all-reduce-done((f32[1024]{0}, "
+        "f32[1024]{0}) %s)\n"
+        "}\n"
+    )
+    comm = fa.audit_hlo(hlo, devices_per_pod=2)["comm"]
+    # the -done half carries no payload of its own and is not counted
+    assert comm["by_op"] == {"all-reduce": 2}
+    start = next(c for c in comm["top"] if c["name"] == "s")
+    assert start["op"] == "all-reduce"
+    assert start["operand_bytes"] == 4096  # ONE operand, not the tuple
+    assert start["tier"] == "ici"
+    multi = next(c for c in comm["top"] if c["name"] == "t")
+    assert multi["operand_bytes"] == 3 * 16
+    assert multi["tier"] == "dcn"
+
+
+def test_comm_section_unknown_without_pod_info():
+    """No devices_per_pod -> no tier claims: everything rolls up under
+    'unknown' instead of guessing."""
+    comm = fa.audit_hlo(_CANNED_COMM)["comm"]
+    assert set(comm["tiers"]) == {"unknown"}
+    assert comm["tiers"]["unknown"]["ops"] == 4
 
 
 def test_audit_compiled_real_program():

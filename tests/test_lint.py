@@ -1929,6 +1929,113 @@ def test_sharding_legality_negatives(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# hardcoded-mesh-axis
+# ---------------------------------------------------------------------------
+
+
+def test_hardcoded_axis_pspec_literal(tmp_path):
+    """A declared axis name spelled as a string literal in a
+    PartitionSpec outside parallel/ is flagged; the imported-constant
+    spelling and non-axis strings pass."""
+    import textwrap
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    (tmp_path / "layers.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+            from .mesh import DATA_AXIS
+
+            def specs():
+                bad = P("data", None)
+                bad_tuple = P((DATA_AXIS, "model"))
+                good = P(DATA_AXIS, None)
+                not_an_axis = P("rows")  # undeclared: sharding-legality's job
+                return bad, bad_tuple, good, not_an_axis
+            """
+        )
+    )
+    vs = _lint_dir(tmp_path, select=["hardcoded-mesh-axis"])
+    assert rule_names(vs) == ["hardcoded-mesh-axis"] * 2
+    assert "'data'" in vs[0].message and "DATA_AXIS" in vs[0].message
+    assert "'model'" in vs[1].message
+
+
+def test_hardcoded_axis_collective_and_shard_map(tmp_path):
+    """The axis argument of named collectives (positional and axis_name=)
+    and shard_map manual_axes/auto sets are covered."""
+    import textwrap
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    (tmp_path / "comms.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def reduce_all(x, fn, mesh):
+                a = jax.lax.psum(x, "data")
+                b = jax.lax.all_gather(x, axis_name="seq")
+                fn2 = jax.shard_map(
+                    fn, mesh=mesh, in_specs=(), out_specs=(),
+                    manual_axes=frozenset({"model"}), check_vma=True,
+                )
+                return a, b, fn2
+            """
+        )
+    )
+    vs = _lint_dir(tmp_path, select=["hardcoded-mesh-axis"])
+    assert rule_names(vs) == ["hardcoded-mesh-axis"] * 3
+    assert "'data'" in vs[0].message
+    assert "'seq'" in vs[1].message
+    assert "'model'" in vs[2].message
+
+
+def test_hardcoded_axis_negatives(tmp_path):
+    """parallel/ modules (the declaration layer) may spell literals, the
+    '# lint: axis-literal-ok' escape works, and a tree with no plan/mesh
+    declaration leaves the rule inert."""
+    import textwrap
+
+    code_no_decl = textwrap.dedent(
+        """
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data")
+        """
+    )
+    (tmp_path / "code.py").write_text(code_no_decl)
+    assert _lint_dir(tmp_path, select=["hardcoded-mesh-axis"]) == []
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    par = tmp_path / "parallel"
+    par.mkdir()
+    (par / "presets.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            BATCH = P(("data",))  # declaration layer: literals allowed
+            """
+        )
+    )
+    (tmp_path / "escaped.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def toy_mesh_sum(x):
+                # fixture mesh with its own axis vocabulary
+                return jax.lax.psum(x, "data")  # lint: axis-literal-ok
+            """
+        )
+    )
+    vs = _lint_dir(tmp_path, select=["hardcoded-mesh-axis"])
+    assert [v.rule for v in vs if "code.py" not in v.path] == []
+    # code.py's literal IS now flagged (a declaration exists)
+    assert all("code.py" in v.path for v in vs) and len(vs) == 1
+
+
+# ---------------------------------------------------------------------------
 # unsynchronized-shared-state
 # ---------------------------------------------------------------------------
 

@@ -32,6 +32,7 @@ import unicore_tpu.analysis.dead_flags  # noqa: E402,F401
 # whole-program engine + the interprocedural analyses riding it
 import unicore_tpu.analysis.collective_divergence  # noqa: E402,F401
 import unicore_tpu.analysis.sharding_legality  # noqa: E402,F401
+import unicore_tpu.analysis.hardcoded_axis  # noqa: E402,F401
 import unicore_tpu.analysis.shared_state  # noqa: E402,F401
 import unicore_tpu.analysis.escapes  # noqa: E402,F401
 

@@ -25,6 +25,18 @@ walk the optimized HLO, and report
   "Quantized inference") is ``unfused_chains == 0``: every dequant
   multiply lives INSIDE the fusion that consumes it — regression-checked
   device-free by tests/test_quant.py,
+- a **comm section** (``comm``): every collective op in the program
+  (all-reduce / reduce-scatter / all-gather / all-to-all /
+  collective-permute, sync or async-start form) with its operand and
+  result bytes and its ``replica_groups``, rolled up by TOPOLOGY TIER
+  when the caller supplies ``devices_per_pod`` (the ParallelPlan's pod
+  extent): a group whose members all share ``id // devices_per_pod``
+  stays inside one pod (``ici``); a group spanning pods crosses the slow
+  tier (``dcn``).  This is the device-free proof surface for the
+  two-level gradient reduction (parallel/hierarchy.py): with a 2-pod
+  plan the ``dcn`` tier's operand bytes must be at most ``1/pod_size``
+  of the flat-buffer bytes (tests/test_hierarchy.py regression-checks
+  it against the flat all-reduce program),
 - a **peak-memory section** (``memory``): the compiler's own per-device
   allocation stats — argument / output / temp / aliased bytes plus
   ``peak_bytes`` (argument + output + temp − alias, the static upper bound
@@ -74,6 +86,14 @@ _ELEMENTWISE_OPS = frozenset({
     "sign", "sine", "sqrt", "subtract", "tan", "tanh", "xor",
 })
 
+#: collective opcodes (async ``-start`` halves normalize to the sync name;
+#: the ``-done`` halves carry no payload of their own)
+_COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+})
+_COLLECTIVE_START_SUFFIX = "-start"
+
 _SHAPE_RE = re.compile(r"\b([a-z]+\d*(?:e\d+m\d+(?:fn)?)?)\[([\d,]*)\]")
 _INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\([^=]*?\)|\S+)\s+([\w\-]+)\("
@@ -81,6 +101,37 @@ _INSTR_RE = re.compile(
 _COMP_RE = re.compile(r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s+\([^)]*\)\s*->.*{\s*$")
 _CALLED_RE = re.compile(r"(?:calls|to_apply)=%([\w.\-]+)")
 _OPERAND_RE = re.compile(r"%([\w.\-]+)")
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[\d,]*\},?)+)\}")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[\d,]*\},?)+)\}")
+_GROUP_RE = re.compile(r"\{([\d,]*)\}")
+
+
+def _parse_groups(line: str) -> List[List[int]]:
+    """Device-id groups out of ``replica_groups={{..},..}`` (or
+    ``source_target_pairs`` for collective-permute) — empty when the
+    line carries neither or uses a form we don't parse (e.g. the iota
+    ``[g,s]<=[..]`` encoding), in which case the tier stays unknown."""
+    m = _REPLICA_GROUPS_RE.search(line) or _PAIRS_RE.search(line)
+    if not m:
+        return []
+    groups = []
+    for body in _GROUP_RE.findall(m.group(1)):
+        ids = [int(t) for t in body.split(",") if t]
+        if ids:
+            groups.append(ids)
+    return groups
+
+
+def _comm_tier(groups: List[List[int]], devices_per_pod: Optional[int]):
+    """'ici' when every group stays inside one pod, 'dcn' when any group
+    spans pods, None (unknown) without classification info."""
+    if not groups or not devices_per_pod or devices_per_pod <= 0:
+        return None
+    for ids in groups:
+        pods = {i // devices_per_pod for i in ids}
+        if len(pods) > 1:
+            return "dcn"
+    return "ici"
 
 
 def _shape_bytes(text: str) -> int:
@@ -113,8 +164,13 @@ def _split_computations(hlo: str) -> List[dict]:
     return comps
 
 
-def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
-    """Walk one optimized HLO module; return the audit report dict."""
+def audit_hlo(
+    hlo: str, top_n: int = 5, devices_per_pod: Optional[int] = None
+) -> Dict:
+    """Walk one optimized HLO module; return the audit report dict.
+    ``devices_per_pod`` (from the ParallelPlan) lets the ``comm``
+    section classify each collective's replica groups by topology
+    tier."""
     comps = _split_computations(hlo)
     # computations referenced via calls=/to_apply= are bodies of their
     # caller (fusion regions, reduce combiners): their instructions are
@@ -131,6 +187,7 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
     chains: List[Dict] = []
     dequant_converts: List[str] = []
     dequant_chains: List[str] = []
+    collectives: List[Dict] = []
 
     for comp in comps:
         if comp["name"] in called:
@@ -154,6 +211,15 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
                     "kind": kind,
                     "bytes": _shape_bytes(line.split(", kind=")[0]),
                 })
+            base_op = (
+                opcode[: -len(_COLLECTIVE_START_SUFFIX)]
+                if opcode.endswith(_COLLECTIVE_START_SUFFIX)
+                else opcode
+            )
+            if base_op in _COLLECTIVE_OPS:
+                collectives.append(
+                    _collective_entry(name, base_op, line, devices_per_pod)
+                )
         chains.extend(_elementwise_chains(instrs))
         cv, ch = _dequant_chains(instrs)
         dequant_converts.extend(cv)
@@ -161,7 +227,9 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
 
     fusions.sort(key=lambda f: -f["bytes"])
     chains.sort(key=lambda c: -c["length"])
+    comm = _comm_rollup(collectives, top_n)
     return {
+        "comm": comm,
         "instructions": instructions,
         "kernels": kernels,
         "fusions": len(fusions),
@@ -175,6 +243,62 @@ def audit_hlo(hlo: str, top_n: int = 5) -> Dict:
             "unfused_chains": len(dequant_chains),
             "examples": sorted(dequant_chains)[:top_n],
         },
+    }
+
+
+def _collective_entry(
+    name: str, op: str, line: str, devices_per_pod: Optional[int]
+) -> Dict:
+    """One comm-section row: operand/result bytes + tier for one
+    collective instruction line."""
+    m = _INSTR_RE.match(line)
+    result_bytes = _shape_bytes(m.group(2)) if m else 0
+    # operand shapes sit between the OPCODE's '(' — which is exactly
+    # where _INSTR_RE's match ends — and the next ')'.  Searching from
+    # the line's first '(' would land on the result shape for
+    # tuple-result collectives (the async '-start' forms emit
+    # '(f32[..], f32[..]) all-reduce-start(...)') and misread the tuple
+    # contents as operands.  Array operand shapes use square/curly
+    # brackets only, so the first ')' past the opcode closes the list.
+    operand_bytes = 0
+    if m:
+        close = line.find(")", m.end())
+        if close > m.end():
+            operand_bytes = _shape_bytes(line[m.end():close])
+    groups = _parse_groups(line)
+    tier = _comm_tier(groups, devices_per_pod)
+    return {
+        "name": name,
+        "op": op,
+        "operand_bytes": operand_bytes,
+        "result_bytes": result_bytes,
+        "groups": len(groups),
+        "group_size": max((len(g) for g in groups), default=0),
+        "tier": tier or "unknown",
+    }
+
+
+def _comm_rollup(collectives: List[Dict], top_n: int) -> Dict:
+    """The ``comm`` report section: per-op counts, per-tier byte
+    rollups, and the top collectives by operand bytes."""
+    by_op: Dict[str, int] = {}
+    tiers = {
+        t: {"ops": 0, "operand_bytes": 0, "result_bytes": 0}
+        for t in ("ici", "dcn", "unknown")
+    }
+    for c in collectives:
+        by_op[c["op"]] = by_op.get(c["op"], 0) + 1
+        t = tiers[c["tier"]]
+        t["ops"] += 1
+        t["operand_bytes"] += c["operand_bytes"]
+        t["result_bytes"] += c["result_bytes"]
+    top = sorted(collectives, key=lambda c: -c["operand_bytes"])[:top_n]
+    return {
+        "collectives": len(collectives),
+        "by_op": by_op,
+        "operand_bytes_total": sum(c["operand_bytes"] for c in collectives),
+        "tiers": {t: v for t, v in tiers.items() if v["ops"]},
+        "top": top,
     }
 
 
@@ -263,7 +387,9 @@ def _elementwise_chains(instrs) -> List[Dict]:
     return out
 
 
-def audit_compiled(compiled, top_n: int = 5) -> Optional[Dict]:
+def audit_compiled(
+    compiled, top_n: int = 5, devices_per_pod: Optional[int] = None
+) -> Optional[Dict]:
     """Audit a ``jax`` compiled executable (``lowered.compile()`` result).
     Adds the compiler's own memory analysis when available.  Returns None
     when the executable exposes no HLO text (audits must never raise)."""
@@ -273,7 +399,7 @@ def audit_compiled(compiled, top_n: int = 5) -> Optional[Dict]:
         return None
     if not hlo:
         return None
-    report = audit_hlo(hlo, top_n=top_n)
+    report = audit_hlo(hlo, top_n=top_n, devices_per_pod=devices_per_pod)
     try:
         mem = compiled.memory_analysis()
         arg_b = int(mem.argument_size_in_bytes)

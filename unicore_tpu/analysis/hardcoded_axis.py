@@ -1,0 +1,162 @@
+"""hardcoded-mesh-axis: axis-name string literals outside the plan.
+
+The :class:`~unicore_tpu.parallel.plan.ParallelPlan` declares every mesh
+axis once (``parallel/plan.py``: ``POD_AXIS``/``DATA_AXIS``/... and
+``ALL_AXES``); ``parallel/`` modules may spell the literals (they ARE the
+declaration and its immediate construction layer), but everywhere else a
+string literal like ``"data"`` in a ``PartitionSpec`` or ``psum`` call
+site is a silent fork of the declaration: rename an axis in the plan and
+the literal keeps compiling — ``sharding-legality`` catches the rename
+only when the literal is statically visible to it, and a literal that
+matches a DIFFERENT still-declared axis (``"data"`` vs ``"pod"``) is
+undetectable by any checker.  The fix is mechanical: import the axis
+constant (``from unicore_tpu.parallel import DATA_AXIS``).
+
+The rule flags a string literal equal to a declared axis name appearing
+in:
+
+* ``PartitionSpec``/``P(...)`` positional entries (including tuple
+  entries like ``P(("pod", "data"))``),
+* the axis argument of a ``jax.lax`` named collective (``psum``,
+  ``all_gather``, ``psum_scatter``, ``ppermute``, ``axis_index``, ...),
+  positional or via ``axis_name=``,
+* a ``shard_map`` ``auto=``/``manual_axes=`` frozenset.
+
+Scope: every linted module outside the DECLARING tree's ``parallel/``
+and ``analysis/`` packages (the declaration layer, and rule fixtures /
+declaration parsers, spell axis names by necessity; the exemption is
+anchored to the directory holding the discovered ``plan.py``/``mesh.py``
+so an unrelated directory merely named ``parallel`` elsewhere cannot
+silence the rule).  Escape: ``# lint: axis-literal-ok`` on the
+line (or the line above) for the rare site that genuinely wants a
+foreign-mesh axis name (e.g. a test fixture building a toy mesh).
+Declared axes come from the same ``plan.py``/``mesh.py`` declaration
+``sharding-legality`` reads, so the two rules can never disagree about
+what an axis is.
+"""
+
+import ast
+import os
+from typing import Iterator, List, Sequence
+
+from unicore_tpu.analysis.core import (
+    LintRule,
+    ModuleInfo,
+    Violation,
+    register_lint_rule,
+    terminal_name,
+)
+from unicore_tpu.analysis.sharding_legality import (
+    _AXIS_CALLS,
+    _AXIS_KWARG_CALLS,
+    _axis_declaration,
+)
+
+def _exempt_dirs(declarer_path: str):
+    """Directories whose modules may spell axis literals, ANCHORED to
+    the tree holding the declaration (a stray directory merely NAMED
+    'parallel' or 'analysis' elsewhere in a linted project must not
+    silence the rule): the ``parallel``/``analysis`` packages of the
+    declarer's own tree."""
+    decl_dir = os.path.dirname(os.path.normpath(declarer_path))
+    root = (
+        os.path.dirname(decl_dir)
+        if os.path.basename(decl_dir) == "parallel"
+        else decl_dir
+    )
+    return (
+        os.path.join(root, "parallel"),
+        os.path.join(root, "analysis"),
+    )
+
+
+def _exempt(path: str, declarer_path: str, exempt_dirs) -> bool:
+    norm = os.path.normpath(path)
+    if norm == os.path.normpath(declarer_path):
+        return True  # the declaration itself, wherever it lives
+    mod_dir = os.path.dirname(norm)
+    return any(
+        mod_dir == d or mod_dir.startswith(d + os.sep) for d in exempt_dirs
+    )
+
+
+@register_lint_rule("hardcoded-mesh-axis")
+class HardcodedMeshAxis(LintRule):
+    name = "hardcoded-mesh-axis"
+    scope = "project"
+    justifications = ("axis-literal-ok",)
+    description = (
+        "a string literal naming a declared mesh axis ('data', 'model', "
+        "'pod', ...) at a PartitionSpec/psum/shard_map call site outside "
+        "parallel/ — axis names must come from the ParallelPlan's "
+        "constants (from unicore_tpu.parallel import DATA_AXIS) so an "
+        "axis rename cannot silently strand call sites; escape with "
+        "'# lint: axis-literal-ok'"
+    )
+
+    def check_project(
+        self, modules: Sequence[ModuleInfo]
+    ) -> Iterator[Violation]:
+        declarer, _constants, declared = _axis_declaration(modules)
+        if declarer is None or not declared:
+            return
+        exempt_dirs = _exempt_dirs(declarer.path)
+        for module in modules:
+            if _exempt(module.path, declarer.path, exempt_dirs):
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = terminal_name(node.func)
+                literals: List[ast.Constant] = []
+                if name in ("PartitionSpec", "P"):
+                    for arg in node.args:
+                        literals.extend(_str_literals(arg))
+                elif name in _AXIS_CALLS or name in _AXIS_KWARG_CALLS:
+                    pos = _AXIS_CALLS.get(name)
+                    if pos is not None and len(node.args) > pos:
+                        literals.extend(_str_literals(node.args[pos]))
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_name", "axis"):
+                            literals.extend(_str_literals(kw.value))
+                elif name == "shard_map":
+                    for kw in node.keywords:
+                        if kw.arg in ("auto", "manual_axes"):
+                            literals.extend(_str_literals(kw.value))
+                for lit in literals:
+                    if lit.value in declared:
+                        yield Violation(
+                            self.name,
+                            module.path,
+                            lit.lineno,
+                            lit.col_offset,
+                            f"axis name '{lit.value}' hardcoded as a "
+                            "string literal; import the plan's constant "
+                            "instead (from unicore_tpu.parallel import "
+                            f"{_constant_for(lit.value)}) so an axis "
+                            "rename in parallel/plan.py cannot strand "
+                            "this call site "
+                            "(docs/lint.md, 'hardcoded-mesh-axis')",
+                        )
+
+
+def _str_literals(node: ast.AST) -> List[ast.Constant]:
+    """Every string-constant node inside one axis-argument expression
+    (plain literal, tuple/list/set entries, frozenset(...) contents)."""
+    out: List[ast.Constant] = []
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            out.append(node)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            out.extend(_str_literals(el))
+    elif isinstance(node, ast.Call) and terminal_name(node.func) in (
+        "frozenset", "set", "tuple", "list"
+    ):
+        for arg in node.args:
+            out.extend(_str_literals(arg))
+    return out
+
+
+def _constant_for(axis: str) -> str:
+    return f"{axis.upper()}_AXIS"

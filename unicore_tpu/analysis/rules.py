@@ -492,7 +492,11 @@ class ImpureCallable(LintRule):
 @register_lint_rule("unsafe-shard-map")
 class UnsafeShardMap(LintRule):
     name = "unsafe-shard-map"
-    justifications = ("jax-version-pinned",)
+    # accepted pin justifications: 'jax-version-pinned' (an API-generation
+    # pin) and 'replicated-by-collectives' (outputs made replicated by the
+    # region's own trailing psum/all_gather, which the 0.4.x rep checker
+    # cannot prove through data-dependent slicing — parallel/hierarchy.py)
+    justifications = ("jax-version-pinned", "replicated-by-collectives")
     description = (
         "shard_map with replication checking disabled (check_vma=False "
         "on the vma-typed API, check_rep=False on the 0.4.x experimental "

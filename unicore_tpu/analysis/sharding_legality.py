@@ -1,15 +1,17 @@
 """sharding-legality: axis names at sharding call sites checked against
-the mesh declaration.
+the ParallelPlan declaration.
 
-``parallel/mesh.py`` is the single source of truth for every parallelism
-axis (ROADMAP item 1's declarative plan config); XLA, however, learns an
-axis name only at run time — a ``PartitionSpec("modle")`` typo, a ``psum``
-over an axis the mesh never declared, or an ``in_specs`` tuple that
-doesn't match the wrapped function's signature all surface as opaque
-runtime errors deep inside jit.  This analysis is the static half: it
-reads the axis declaration out of the linted ``mesh.py`` (the module-level
-``*_AXIS = "name"`` constants and the ``ALL_AXES`` tuple / ``Mesh(...)``
-axis-name argument) and checks every sharding call site in the lint set:
+``parallel/plan.py`` — the declarative :class:`ParallelPlan` — is the
+single source of truth for every parallelism axis (ROADMAP item 1); XLA,
+however, learns an axis name only at run time — a
+``PartitionSpec("modle")`` typo, a ``psum`` over an axis the plan never
+declared, or an ``in_specs`` tuple that doesn't match the wrapped
+function's signature all surface as opaque runtime errors deep inside
+jit.  This analysis is the static half: it reads the axis declaration out
+of the linted ``plan.py`` (the module-level ``*_AXIS = "name"`` constants
+and the ``ALL_AXES`` tuple; a fixture tree without a ``plan.py`` falls
+back to ``mesh.py``'s constants / ``Mesh(...)`` axis-name argument) and
+checks every sharding call site in the lint set:
 
 * **undeclared-axis** — a resolvable axis name (string literal, a
   ``*_AXIS`` constant imported from mesh.py, or a local string constant)
@@ -33,8 +35,8 @@ axis-name argument) and checks every sharding call site in the lint set:
 
 Axis names that cannot be resolved statically (parameters, computed
 strings) are skipped — zero-noise bias, same trade as every other rule.
-When no ``mesh.py`` is in the lint set the rule is inert (there is no
-declaration to check against).
+When neither ``plan.py`` nor ``mesh.py`` is in the lint set the rule is
+inert (there is no declaration to check against).
 """
 
 import ast
@@ -69,12 +71,18 @@ _AXIS_KWARG_CALLS = frozenset(
 )
 
 
-def _mesh_declaration(modules: Sequence[ModuleInfo]):
-    """``(mesh module, axis constants {NAME: value}, declared axis set)``
-    from the first ``mesh.py`` in the lint set, else ``(None, {}, set())``."""
+def _axis_declaration(modules: Sequence[ModuleInfo]):
+    """``(declaring module, axis constants {NAME: value}, declared axis
+    set)`` from the ParallelPlan module (``plan.py``) in the lint set —
+    falling back to ``mesh.py`` for trees (fixtures) that predate the
+    plan — else ``(None, {}, set())``."""
+    by_name = {"plan.py": None, "mesh.py": None}
     for module in modules:
-        if os.path.basename(os.path.normpath(module.path)) != "mesh.py":
-            continue
+        base = os.path.basename(os.path.normpath(module.path))
+        if base in by_name and by_name[base] is None:
+            by_name[base] = module
+    declarer = by_name["plan.py"] or by_name["mesh.py"]
+    for module in ([declarer] if declarer is not None else []):
         constants: Dict[str, str] = {}
         declared: Set[str] = set()
         for node in module.tree.body:
@@ -139,7 +147,11 @@ class _ModuleEnv:
                     local = a.asname or a.name
                     if a.name == "PartitionSpec" and "sharding" in node.module:
                         self.pspec_names.add(local)
-                    if base == "mesh" and a.name in mesh_constants:
+                    # axis constants re-exported along the plan -> mesh ->
+                    # package chain all resolve to the plan's declaration
+                    if base in ("plan", "mesh", "parallel") and (
+                        a.name in mesh_constants
+                    ):
                         self.constants[local] = mesh_constants[a.name]
             elif isinstance(node, ast.Assign) and len(node.targets) == 1:
                 t = node.targets[0]
@@ -183,8 +195,8 @@ class ShardingLegality(LintRule):
     def check_project(
         self, modules: Sequence[ModuleInfo]
     ) -> Iterator[Violation]:
-        mesh_module, constants, declared = _mesh_declaration(modules)
-        if mesh_module is None or not declared:
+        plan_module, constants, declared = _axis_declaration(modules)
+        if plan_module is None or not declared:
             return
         # the data axis name for the zero-buffer-axis check (DATA_AXIS
         # constant, else the literal 'data' when declared)

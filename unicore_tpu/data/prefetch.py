@@ -193,9 +193,9 @@ class DevicePrefetcher:
 
         self._nproc = jax.process_count()
         self._rank = jax.process_index()
-        from unicore_tpu.parallel import DATA_AXIS
+        from unicore_tpu.parallel import dp_world_size
 
-        self._data_size = trainer.mesh.shape[DATA_AXIS]
+        self._data_size = dp_world_size(trainer.mesh)
         self._client = kv_client() if self._nproc > 1 else None
 
         # item sequence numbers key the KV plan exchange; they start at the

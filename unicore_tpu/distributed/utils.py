@@ -179,6 +179,29 @@ def get_data_parallel_num_shards() -> int:
     return jax.device_count()
 
 
+def get_pod_count() -> int:
+    """Number of pods the ParallelPlan declares (the DCN tier of the dp
+    dimension, ``--num-pods``); 1 when no plan is published or the plan
+    is single-pod."""
+    from unicore_tpu.parallel import get_global_plan
+
+    plan = get_global_plan()
+    return plan.pods if plan is not None else 1
+
+
+def get_pod_index() -> int:
+    """Which pod this process's FIRST device lives in, under the plan's
+    mesh layout ('pod' is the outermost axis, so pod p owns the
+    contiguous device block [p * devices_per_pod, (p+1) *
+    devices_per_pod)).  0 on single-pod plans — rank-0-of-pod-0 guards
+    degrade to plain rank-0 guards."""
+    pods = get_pod_count()
+    if pods <= 1:
+        return 0
+    devices_per_pod = max(1, jax.device_count() // pods)
+    return (jax.process_index() * jax.local_device_count()) // devices_per_pod
+
+
 def get_global_rank() -> int:
     return jax.process_index()
 

@@ -27,6 +27,7 @@ from unicore_tpu.models.unicore_model import (
 )
 from unicore_tpu.modules import LayerNorm, TransformerEncoder, bert_init
 from unicore_tpu.modules.remat import resolve_remat_policy as _resolve_remat
+from unicore_tpu.parallel.plan import resolve_deterministic_reductions
 
 
 class BertLMHead(nn.Module):
@@ -177,16 +178,14 @@ class BertModel(BaseUnicoreModel):
                             help="experts per token")
         parser.add_argument("--moe-deterministic-reduction",
                             action="store_true",
-                            help="fix the f32 reduction order of the expert "
-                                 "combine by replicating the token stream "
-                                 "through the MoE block: the training "
-                                 "trajectory becomes independent of the "
-                                 "dp/ep mesh split (dp=8 == dp=4 x ep=2) at "
-                                 "the cost of redundant replicated FFN "
-                                 "compute; also disables MoE router jitter "
-                                 "and expert activation dropout, which are "
-                                 "inherently order-sensitive "
-                                 "(docs/PARALLELISM.md)")
+                            help="DEPRECATED alias for the plan-wide "
+                                 "--deterministic-reductions (warns once): "
+                                 "fixed f32 reduction order for the expert "
+                                 "combine via a replicated token stream — "
+                                 "now one property of the ParallelPlan "
+                                 "that also pins the two-level gradient "
+                                 "reduction's order "
+                                 "(docs/PARALLELISM.md, 'The plan')")
         parser.add_argument("--pipeline-microbatches", type=int,
                             help="GPipe microbatches per update when "
                                  "--pipeline-parallel-size > 1 (batch must "
@@ -218,9 +217,9 @@ class BertModel(BaseUnicoreModel):
             moe_experts=getattr(args, "moe_experts", 0) or 0,
             moe_every=getattr(args, "moe_every", 2) or 2,
             moe_top_k=getattr(args, "moe_top_k", 2) or 2,
-            moe_deterministic=getattr(
-                args, "moe_deterministic_reduction", False
-            ),
+            # plan property (--deterministic-reductions; the old MoE-only
+            # spelling folds in with a one-shot deprecation warning)
+            moe_deterministic=resolve_deterministic_reductions(args),
             pipeline_stages=(
                 pp if (pp := getattr(args, "pipeline_parallel_size", 1)) > 1
                 else 0

@@ -26,6 +26,13 @@ Numerics contract (tests/test_multi_tensor.py):
   different random stream than the tree path (divergence bounded by 1 bf16
   ulp per element).
 
+Multi-pod (the two-level reduction): when the ParallelPlan declares a
+``dcn`` tier over dp (``--num-pods``), the gradient reduction itself
+rides THESE buffers — ``parallel/hierarchy.py`` ravels grads through the
+same :func:`plan_for` segment table, reduce-scatters in-pod, combines
+cross-pod on 1/pod_size of the bytes, and unflattens — so the comm
+schedule and the fused update agree on layout by construction.
+
 ZeRO compatibility: the optimizer STATE stays a per-leaf pytree (same
 checkpoint format, same ``zero1_pspecs`` sharding tree); flattening happens
 inside the jitted step, where GSPMD propagates the sharded layouts through
@@ -166,13 +173,19 @@ def _zero_mesh():
     return mesh, mesh.shape[DATA_AXIS]
 
 
-def _pad_to(buf: jnp.ndarray, mult: int) -> jnp.ndarray:
-    """Zero-pad a 1-D buffer so its length divides ``mult`` (the data-axis
-    size) — the padding never feeds a reduction, so values are unchanged."""
+def pad_to(buf: jnp.ndarray, mult: int) -> jnp.ndarray:
+    """Zero-pad a 1-D flat buffer so its length divides ``mult`` (a dp
+    extent) — the padding never feeds a reduction over the flat dim, so
+    values are unchanged.  Shared by the ZeRO-2/3 sharding below and the
+    two-level (pod-tier) reduction in ``parallel/hierarchy.py``, which
+    pads to the in-pod size before its reduce-scatter."""
     rem = (-buf.shape[0]) % mult
     if rem == 0:
         return buf
     return jnp.concatenate([buf, jnp.zeros((rem,), buf.dtype)])
+
+
+_pad_to = pad_to  # internal alias (pre-existing call sites)
 
 
 def _zero_shard(bufs: List[jnp.ndarray], mesh, ndata: int):

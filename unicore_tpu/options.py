@@ -710,6 +710,36 @@ def add_distributed_training_args(parser, default_world_size=None):
                        help="size of the 'pipe' (pipeline-parallel) mesh axis")
     group.add_argument("--expert-parallel-size", type=int, default=1, metavar="N",
                        help="size of the 'expert' mesh axis for MoE layers")
+    group.add_argument("--num-pods", type=int, default=1, metavar="N",
+                       help="size of the 'pod' mesh axis — the DCN tier of "
+                            "the data-parallel dimension (total dp = "
+                            "num-pods x data-parallel-size, with the data "
+                            "axis inside each pod on ICI).  With N > 1 the "
+                            "gradient reduction becomes two-level: "
+                            "reduce-scatter inside the pod over ICI, then "
+                            "the --xpod-combine cross-pod combine over DCN "
+                            "on 1/pod_size of the bytes "
+                            "(docs/PARALLELISM.md, 'The plan')")
+    group.add_argument("--xpod-combine", default="sum",
+                       choices=["sum", "adasum"],
+                       help="cross-pod gradient combine when --num-pods > "
+                            "1: 'sum' (plain addition; bit-identical to "
+                            "the flat all-reduce at pod_size 1) or "
+                            "'adasum' (adaptive summation, arXiv "
+                            "2006.02924: orthogonal gradients add, "
+                            "parallel gradients average — stabilizes the "
+                            "large effective batches multi-pod dp creates)")
+    group.add_argument("--deterministic-reductions", action="store_true",
+                       help="fix every reduction order the plan controls: "
+                            "the two-level gradient reduction gathers and "
+                            "folds in rank/pod-index order instead of "
+                            "backend-ordered collectives, and the MoE "
+                            "expert combine replicates its token stream "
+                            "(retires --moe-deterministic-reduction, which "
+                            "is now a deprecated alias) — dp/pod/ep mesh "
+                            "splits then reproduce each other bit-close at "
+                            "the cost of extra gather traffic "
+                            "(docs/PARALLELISM.md)")
     group.add_argument("--zero-shard-optimizer", action="store_true",
                        help="DEPRECATED alias for --zero-stage 1 (warns once; "
                             "kept for script compatibility)")

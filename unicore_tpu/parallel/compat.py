@@ -32,6 +32,15 @@ from typing import Optional
 
 import jax
 
+
+def manual_axes_except(mesh, *auto_axes: str) -> frozenset:
+    """The manual-axis set for a partial-manual region: every mesh axis
+    except ``auto_axes``.  One helper so call sites derive the set from
+    the mesh the plan built (parallel/plan.py) instead of hand-listing
+    axis names — a plan that grows an axis (the 'pod' DCN tier did
+    exactly this) then flows through automatically."""
+    return frozenset(mesh.shape) - frozenset(auto_axes)
+
 #: the vma-typed generation is present (and with it, working
 #: partial-manual mode)
 HAS_VMA_SHARD_MAP = hasattr(jax, "shard_map") and hasattr(jax.lax, "pcast")

@@ -1,35 +1,57 @@
 """Device-mesh construction and sharding presets.
 
 No reference equivalent — the reference is DP-only over NCCL process groups
-(/root/reference/unicore/distributed/utils.py:203-233).  Here the mesh is the
-single source of truth for every parallelism axis, designed day-1 for
-(data, fsdp-style param sharding, tensor, sequence, pipeline, expert):
+(/root/reference/unicore/distributed/utils.py:203-233).  The mesh is built
+from ONE declarative :class:`~unicore_tpu.parallel.plan.ParallelPlan`
+(axis names, sizes, topology tiers, legality rules — ``parallel/plan.py``
+is the single source of truth; this module only lays devices):
 
-    axes: ('data', 'model', 'seq', 'pipe', 'expert')  — unused axes size 1
+    axes: ('pod', 'data', 'expert', 'pipe', 'seq', 'model') — unused size 1
 
-XLA lays device order so that the innermost axes ride ICI; DCN carries the
-outer (data) axis on multi-slice topologies.
+XLA lays device order so that the innermost axes ride ICI; the outermost
+``pod`` axis is the only one that may cross DCN on multi-slice
+topologies, and ``pod x data`` together form the data-parallel tier
+(two-level gradient reduction when ``pods > 1`` — parallel/hierarchy.py).
 """
 
 import logging
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# the canonical axis declaration lives in the plan; re-exported here for
+# the many existing `from .mesh import DATA_AXIS` call sites
+from .plan import (  # noqa: F401
+    ALL_AXES,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MESH_AXIS_ORDER,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    POD_AXIS,
+    SEQ_AXIS,
+    ParallelPlan,
+    PlanLegalityError,
+)
+
 logger = logging.getLogger(__name__)
 
-DATA_AXIS = "data"
-MODEL_AXIS = "model"
-SEQ_AXIS = "seq"
-PIPE_AXIS = "pipe"
-EXPERT_AXIS = "expert"
-
-ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
-
 _global_mesh: Optional[Mesh] = None
+
+
+def make_mesh_from_plan(
+    plan: ParallelPlan, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build the device mesh a validated plan describes.  Legality
+    (divisibility, device-count match) raises the plan's NAMED
+    :class:`PlanLegalityError` — never an opaque reshape error."""
+    devices = list(devices if devices is not None else jax.devices())
+    plan = plan.validate(len(devices))
+    dev_array = np.asarray(devices).reshape(plan.mesh_shape())
+    return Mesh(dev_array, MESH_AXIS_ORDER)
 
 
 def make_mesh(
@@ -38,38 +60,25 @@ def make_mesh(
     seq: int = 1,
     pipe: int = 1,
     expert: int = 1,
+    pods: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build the global device mesh.
-
-    ``data=-1`` absorbs all remaining devices.  Axis order is
-    (data, expert, pipe, seq, model): the model/seq axes are innermost so
-    tensor- and sequence-parallel collectives map onto the fastest ICI links.
-    """
-    devices = list(devices if devices is not None else jax.devices())
-    n = len(devices)
-    fixed = model * seq * pipe * expert
-    if data == -1:
-        assert n % fixed == 0, (
-            f"device count {n} not divisible by model*seq*pipe*expert={fixed}"
-        )
-        data = n // fixed
-    assert data * fixed == n, (
-        f"mesh {data}x{expert}x{pipe}x{seq}x{model} != {n} devices"
+    """Build the global device mesh (kwarg convenience over
+    :func:`make_mesh_from_plan`).  ``data=-1`` absorbs all remaining
+    devices."""
+    return make_mesh_from_plan(
+        ParallelPlan(
+            data=data, model=model, seq=seq, pipe=pipe, expert=expert,
+            pods=pods,
+        ),
+        devices=devices,
     )
-    dev_array = np.asarray(devices).reshape(data, expert, pipe, seq, model)
-    return Mesh(dev_array, (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def make_mesh_from_args(args, devices=None) -> Mesh:
-    return make_mesh(
-        data=getattr(args, "data_parallel_size", -1) or -1,
-        model=getattr(args, "model_parallel_size", 1),
-        seq=getattr(args, "seq_parallel_size", 1),
-        pipe=getattr(args, "pipeline_parallel_size", 1),
-        expert=getattr(args, "expert_parallel_size", 1),
-        devices=devices,
-    )
+    from .plan import plan_from_args
+
+    return make_mesh_from_plan(plan_from_args(args), devices=devices)
 
 
 def set_global_mesh(mesh: Mesh):
@@ -79,6 +88,27 @@ def set_global_mesh(mesh: Mesh):
 
 def get_global_mesh() -> Optional[Mesh]:
     return _global_mesh
+
+
+def dp_axis_names(mesh: Optional[Mesh] = None):
+    """The live data-parallel axes of ``mesh`` in mesh order — ('pod',
+    'data'), reduced to the live subset so PartitionSpecs stay minimal on
+    single-pod meshes."""
+    mesh = get_global_mesh() if mesh is None else mesh
+    if mesh is None:
+        return (DATA_AXIS,)
+    axes = tuple(
+        a for a in (POD_AXIS, DATA_AXIS) if mesh.shape.get(a, 1) > 1
+    )
+    return axes or (DATA_AXIS,)
+
+
+def dp_world_size(mesh: Optional[Mesh] = None) -> int:
+    """Total data-parallel device count: pod x in-pod data."""
+    mesh = get_global_mesh() if mesh is None else mesh
+    if mesh is None:
+        return 1
+    return mesh.shape.get(POD_AXIS, 1) * mesh.shape.get(DATA_AXIS, 1)
 
 
 _warned_once = set()
@@ -94,13 +124,15 @@ def warn_once(logger_, msg: str):
     logger_.warning(msg)
 
 
-def batch_spec() -> P:
-    """Batch arrays: sharded over (data, seq if used) on the leading dims."""
-    return P((DATA_AXIS,))
+def batch_spec(mesh: Optional[Mesh] = None) -> P:
+    """Batch arrays: sharded over the data-parallel tier on the leading
+    dim (both halves of dp — 'pod' and 'data' — when a DCN tier is
+    live)."""
+    return P(dp_axis_names(mesh))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, batch_spec())
+    return NamedSharding(mesh, batch_spec(mesh))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -19,7 +19,14 @@ from typing import Any, Callable, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS
+from .mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    dp_axis_names,
+    dp_world_size,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -190,16 +197,18 @@ def params_pspecs(params, use_tp: bool = False, rules=None, mesh: Mesh = None):
 
 
 def zero1_pspecs(params, mesh: Mesh):
-    """ZeRO-1: shard fp32 master params / optimizer moments over the data
-    axis along each leaf's largest divisible dim (optional capability beyond
+    """ZeRO-1: shard fp32 master params / optimizer moments over the
+    data-parallel tier (both dp axes when the plan declares a DCN tier)
+    along each leaf's largest divisible dim (optional capability beyond
     the reference, SURVEY.md §2.3)."""
-    ndata = mesh.shape[DATA_AXIS]
+    ndata = dp_world_size(mesh)
+    dp_axes = dp_axis_names(mesh)
 
     def spec_for(leaf):
         for dim, size in enumerate(leaf.shape):
             if size % ndata == 0 and size >= ndata:
                 spec = [None] * leaf.ndim
-                spec[dim] = DATA_AXIS
+                spec[dim] = dp_axes
                 return P(*spec)
         return P()
 
@@ -245,7 +254,7 @@ def seq_row_constrainer(seq_len: int, enabled: bool, what: str = "stream"):
         identity.engaged = False
         return identity
 
-    data_ax = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    data_ax = dp_axis_names(mesh) if dp_world_size(mesh) > 1 else None
 
     def constrain(t, row_dim):
         spec = [None] * t.ndim
@@ -338,5 +347,7 @@ def seq_pipeline_plan(seq_len: int, enabled: bool, what: str = "stream"):
 
     pin.engaged = True
     pin_inside.engaged = True
-    manual_axes = frozenset(mesh.shape) - {SEQ_AXIS}
+    from unicore_tpu.parallel.compat import manual_axes_except
+
+    manual_axes = manual_axes_except(mesh, SEQ_AXIS)
     return pin, pin_inside, manual_axes

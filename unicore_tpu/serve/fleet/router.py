@@ -117,11 +117,22 @@ class RouterEngine:
 
     # -- replica choice ---------------------------------------------------
 
-    @staticmethod
-    def _score(info: ReplicaInfo) -> Tuple[float, int]:
-        # lease-published estimate first; local in-flight count breaks
-        # ties and covers the staleness window between lease rounds
-        return (info.est_delay_s, info.inflight)
+    #: score gap below which two replicas are "the same" and the choice
+    #: is a coin flip — lease-published estimates quantize coarsely, so
+    #: exact/near ties are common and must not deterministically favor
+    #: either sample
+    _TIE_EPS = 1e-6
+
+    def _score(self, info: ReplicaInfo, cost_s: float) -> float:
+        # The lease-published admission estimate is STALE between lease
+        # rounds — and a replica that receives no traffic never updates
+        # its EMA, so strictly ordering on the raw estimate herds ALL
+        # traffic onto whichever replica happened to publish the lowest
+        # number (the PR-13 bench: by_replica {"b0": 285} at n=2).  The
+        # fresh local signal is the router's own in-flight count: cost
+        # each dispatched-but-unfinished request forward at a typical
+        # per-request delay so the herd self-limits within one round.
+        return info.est_delay_s + info.inflight * cost_s
 
     def pick_replica(self, exclude=()) -> Optional[ReplicaInfo]:
         candidates = [
@@ -132,7 +143,16 @@ class RouterEngine:
         if len(candidates) == 1:
             return candidates[0]
         a, b = self._rng.sample(candidates, 2)
-        return a if self._score(a) <= self._score(b) else b
+        # per-inflight cost: the pair's own estimates are the best local
+        # notion of "one request's worth of delay" (floored so a cold
+        # fleet publishing 0.0 still pays a nonzero congestion cost)
+        cost_s = max(a.est_delay_s, b.est_delay_s, 0.001)
+        sa, sb = self._score(a, cost_s), self._score(b, cost_s)
+        if abs(sa - sb) <= self._TIE_EPS:
+            # jittered tie: equal (or stale-identical) estimates spread
+            # instead of collapsing onto the first sample
+            return a if self._rng.random() < 0.5 else b
+        return a if sa < sb else b
 
     # -- the proxy --------------------------------------------------------
 

@@ -87,17 +87,31 @@ class Trainer(object):
             self.compute_dtype = jnp.float32
         self.use_loss_scale = bool(args.fp16)
 
-        # device mesh: single source of truth for all parallel axes; also
-        # published globally for modules that look the mesh up at trace
-        # time (ring attention's 'seq' axis, the pipeline's 'pipe' axis)
-        self.mesh = make_mesh_from_args(args)
-        from unicore_tpu.parallel import resolve_ddp_preset, set_global_mesh
+        # ONE declarative parallelism plan (parallel/plan.py): every CLI
+        # flag resolves into it, the device mesh is constructed from it,
+        # and it is published globally alongside the mesh for modules
+        # that look topology up at trace time (ring attention's 'seq'
+        # axis, the pipeline's 'pipe' axis, the MoE deterministic mode)
+        from unicore_tpu.parallel import (
+            make_mesh_from_plan,
+            plan_from_args,
+            resolve_ddp_preset,
+            set_global_mesh,
+            set_global_plan,
+        )
+
+        self.plan = plan_from_args(args)
+        self.mesh = make_mesh_from_plan(self.plan)
+        # re-resolve with the device count so plan.data / pod_size are
+        # concrete (the -1 absorber is bound at mesh construction)
+        self.plan = self.plan.validate(int(self.mesh.devices.size))
 
         # torch-era --ddp-backend resolves to an XLA-SPMD sharding preset
         # (logged once so operators see what the compat flag actually did)
         self.ddp_preset = resolve_ddp_preset(args)
 
         set_global_mesh(self.mesh)
+        set_global_plan(self.plan)
         from unicore_tpu.parallel import SEQ_AXIS
 
         if self.mesh.shape.get(SEQ_AXIS, 1) > 1 and not (
@@ -117,6 +131,42 @@ class Trainer(object):
             )
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
+
+        # DCN-aware two-level gradient reduction (parallel/hierarchy.py):
+        # when the plan declares a dcn tier over dp (pods > 1) and the
+        # mesh shape supports it, the micro-batch forward/backward runs
+        # full-manual over the dp tier and the flat-buffer reduction
+        # becomes reduce-scatter-in-pod (ICI) + cross-pod combine (DCN,
+        # --xpod-combine) + all-gather-in-pod; otherwise flat (XLA psum)
+        from unicore_tpu.parallel import hierarchy as _hierarchy
+
+        self._hier_fb = None
+        hier_ok, hier_reason = _hierarchy.engaged(self.plan, self.mesh)
+        if hier_ok and getattr(args, "per_sample_clip_norm", 0.0) > 0:
+            # the per-sample path vmaps per-row grads and clips before
+            # accumulation — it bypasses _forward_backward's hier
+            # dispatch, so claiming engagement here would put a wrong
+            # topology record in the log and the comm-plan journal
+            hier_ok, hier_reason = False, (
+                "two-level gradient reduction: --per-sample-clip-norm "
+                "uses the per-sample vmap path, which does not route "
+                "through the two-level reduction; running the flat "
+                "reduction (every gradient byte crosses DCN) — drop "
+                "--per-sample-clip-norm to engage the two-level path"
+            )
+        if hier_ok:
+            self._hier_fb = _hierarchy.wrap_forward_backward(
+                self._forward_backward_flat, self.mesh, self.plan
+            )
+            logger.info(
+                f"two-level gradient reduction engaged: pods={self.plan.pods} "
+                f"x pod_size={self.plan.pod_size}, xpod-combine="
+                f"{self.plan.xpod_combine}, deterministic="
+                f"{self.plan.deterministic_reductions} (cross-pod DCN bytes "
+                f"= 1/{self.plan.pod_size} of the flat-buffer bytes)"
+            )
+        elif hier_reason:
+            logger.warning(hier_reason)
 
         self._optimizer = build_optimizer(args)
         # memory-headroom tier: ZeRO stage (1 = per-leaf master/moments
@@ -208,13 +258,14 @@ class Trainer(object):
 
     @property
     def data_parallel_world_size(self):
-        # the DATA mesh axis only — under TP/SP the model/seq devices are not
-        # data-parallel replicas, and the reference's fp16 scale-window
-        # default 2**14/world_size counts data replicas
+        # the data-parallel TIER only (pod x data — both halves of dp
+        # when the plan declares a dcn tier) — under TP/SP the model/seq
+        # devices are not data-parallel replicas, and the reference's
+        # fp16 scale-window default 2**14/world_size counts data replicas
         # (reference fp16_optimizer.py:323-332)
-        from unicore_tpu.parallel import DATA_AXIS
+        from unicore_tpu.parallel import dp_world_size
 
-        return self.mesh.shape[DATA_AXIS]
+        return dp_world_size(self.mesh)
 
     @property
     def data_parallel_rank(self):
@@ -238,11 +289,12 @@ class Trainer(object):
 
     @property
     def data_shards_per_host(self):
-        """How many data-axis shards live on this host — scales the host
-        batch so --batch-size keeps the reference's per-device meaning."""
-        from unicore_tpu.parallel import DATA_AXIS
+        """How many data-parallel shards (across the whole pod x data
+        tier) live on this host — scales the host batch so --batch-size
+        keeps the reference's per-device meaning."""
+        from unicore_tpu.parallel import dp_world_size
 
-        return max(1, self.mesh.shape[DATA_AXIS] // jax.process_count())
+        return max(1, dp_world_size(self.mesh) // jax.process_count())
 
     @property
     def optimizer(self):
@@ -304,6 +356,15 @@ class Trainer(object):
         if self.use_ema:
             master = opt_state["master"] if opt_state["master"] is not None else params
             state["ema"] = init_ema(master)
+        # the comm/topology story of this run, journaled once so traces
+        # and bench rows can join against the plan that produced them
+        # (emitted here, not in __init__: the CLI configures telemetry
+        # between Trainer construction and state init)
+        telemetry.emit(
+            "comm-plan",
+            **self.plan.to_json(),
+            two_level=bool(self._hier_fb is not None),
+        )
         # one-time TrainState placement at init — not hot-loop work
         self._state = jax.device_put(state, self._state_shardings(state))  # lint: explicit-sync
         n_params = sum(
@@ -412,11 +473,27 @@ class Trainer(object):
         return grads, sample_size, logging_output
 
     def _forward_backward(self, params, sample, rng, loss_scale, weight):
-        """Shared micro-batch forward+backward (pure)."""
+        """Shared micro-batch forward+backward (pure) — the dispatch
+        point for HOW the dp gradient reduction runs: per-sample-clip
+        vmaps per-row grads, the two-level path (plan with a live dcn
+        tier, parallel/hierarchy.py) wraps the flat body in a manual
+        region and reduces explicitly, and the default flat body leaves
+        the psum to XLA."""
         if getattr(self.args, "per_sample_clip_norm", 0.0) > 0:
             return self._forward_backward_per_sample(
                 params, sample, rng, loss_scale, weight
             )
+        if self._hier_fb is not None:
+            return self._hier_fb(params, sample, rng, loss_scale, weight)
+        return self._forward_backward_flat(
+            params, sample, rng, loss_scale, weight
+        )
+
+    def _forward_backward_flat(self, params, sample, rng, loss_scale,
+                               weight):
+        """The flat-reduction body: XLA inserts the dp gradient psum
+        from the batch sharding (topology-blind — every byte crosses
+        every tier)."""
 
         def loss_for_grad(p):
             # phase names mirror the reference's record_function annotations
@@ -1261,7 +1338,15 @@ class Trainer(object):
         except Exception as e:
             logger.warning(f"fusion-audit: compile failed: {e!r}")
             return None
-        report = _fa.audit_compiled(compiled, top_n=top_n)
+        # devices_per_pod lets the audit's comm section classify each
+        # collective's replica groups by topology tier (ici vs dcn)
+        report = _fa.audit_compiled(
+            compiled,
+            top_n=top_n,
+            devices_per_pod=(
+                int(self.mesh.devices.size) // max(1, self.plan.pods)
+            ),
+        )
         if report is None:
             logger.warning("fusion-audit: executable exposes no HLO text")
             return None
@@ -1655,7 +1740,7 @@ class Trainer(object):
         array value, silently dropping rows (sharded) or desyncing params
         (replicated)."""
         from unicore_tpu.data.prefetch import plan_slot_modes
-        from unicore_tpu.parallel import DATA_AXIS
+        from unicore_tpu.parallel import dp_world_size
 
         self._count_prep("plan_slots")
         if sigs is None:
@@ -1671,7 +1756,7 @@ class Trainer(object):
         all_sigs = [row[0] for row in gathered]
         stop_flags = [row[1] for row in gathered]
         modes = plan_slot_modes(
-            all_sigs, self.mesh.shape[DATA_AXIS], jax.process_count()
+            all_sigs, dp_world_size(self.mesh), jax.process_count()
         )
         return modes, sigs, stop_flags
 
@@ -1766,15 +1851,15 @@ class Trainer(object):
             return utils.apply_to_sample(np.asarray, sample)
         self._count_prep("prepare_sample")
         # single-host path: tail batches whose row count doesn't divide the
-        # data axis can't be laid out P('data'); replicate those (exact, one
+        # dp tier can't be laid out over it; replicate those (exact, one
         # cached recompile per odd shape)
-        from unicore_tpu.parallel import DATA_AXIS
+        from unicore_tpu.parallel import dp_world_size
 
         leaves = [
             x for x in jax.tree_util.tree_leaves(sample)
             if hasattr(x, "shape") and getattr(x, "ndim", 0) > 0
         ]
-        data_size = self.mesh.shape[DATA_AXIS]
+        data_size = dp_world_size(self.mesh)
         divisible = all(leaf.shape[0] % data_size == 0 for leaf in leaves)
         sharding = self._batch_sharding if divisible else self._replicated
         sample = utils.apply_to_sample(_narrow_dtype, sample)
@@ -1798,7 +1883,7 @@ class Trainer(object):
         epoch), keeping WHICH batch becomes the dummy host-deterministic."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from unicore_tpu.parallel import DATA_AXIS
+        from unicore_tpu.parallel import dp_axis_names, dp_world_size
 
         self._count_prep("stack_microbatches")
         multihost = jax.process_count() > 1
@@ -1818,8 +1903,8 @@ class Trainer(object):
             lambda *xs: np.stack([np.ascontiguousarray(x) for x in xs], axis=0),
             *host,
         )
-        data_size = self.mesh.shape[DATA_AXIS]
-        spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        data_size = dp_world_size(self.mesh)
+        spec = NamedSharding(self.mesh, P(None, dp_axis_names(self.mesh)))
         if multihost:
             with self._transfer_timer():
                 out = utils.apply_to_sample(
