@@ -34,6 +34,8 @@ import unicore_tpu.analysis.collective_divergence  # noqa: E402,F401
 import unicore_tpu.analysis.sharding_legality  # noqa: E402,F401
 import unicore_tpu.analysis.hardcoded_axis  # noqa: E402,F401
 import unicore_tpu.analysis.shared_state  # noqa: E402,F401
+# kernel auditor: always-on AST coverage rule + the --kernels geometry rules
+import unicore_tpu.analysis.pallas_audit  # noqa: E402,F401
 import unicore_tpu.analysis.escapes  # noqa: E402,F401
 
 __all__ = [

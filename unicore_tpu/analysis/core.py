@@ -259,6 +259,7 @@ def build_rules(select: Optional[Sequence[str]] = None) -> List[LintRule]:
     import unicore_tpu.analysis.collective_divergence  # noqa: F401
     import unicore_tpu.analysis.sharding_legality  # noqa: F401
     import unicore_tpu.analysis.shared_state  # noqa: F401
+    import unicore_tpu.analysis.pallas_audit  # noqa: F401
     import unicore_tpu.analysis.escapes  # noqa: F401
 
     names = list(LINT_RULE_REGISTRY.classes)

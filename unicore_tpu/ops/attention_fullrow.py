@@ -36,10 +36,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._pallas import pallas_call as _pallas_call
+# MAX_ROW (full (L, L) fp32 score block must fit VMEM) and the VMEM
+# budget now live in ops/_pallas.py — ONE copy shared with every other
+# kernel's gate and with the --kernels auditor; the historical module
+# names stay as aliases for callers/tests.
+from ._pallas import (
+    KernelGeometryError,
+    MAX_ROW,
+    VMEM_BUDGET as _VMEM_BUDGET,
+    audit_case,
+    pallas_call as _pallas_call,
+)
 from .flash_attention import NEG_INF, _keep_mask, _seed_block
-
-MAX_ROW = 1024  # full (L, L) fp32 score block must fit VMEM
 
 
 def _pick_group(batch, preferred):
@@ -48,9 +56,6 @@ def _pick_group(batch, preferred):
     while batch % g != 0:
         g -= 1
     return g
-
-
-_VMEM_BUDGET = 12 * 1024 * 1024  # leave headroom under the ~16 MB/core VMEM
 
 
 def _auto_group(B, Lq, Lk, D, itemsize, preferred, n_streams, bias_bufs):
@@ -407,15 +412,48 @@ def fullrow_attention(
     if bias is not None:
         if bias.ndim == 3:
             bias = bias[None]
-        assert bias.ndim == 4 and bias.shape[0] == 1, bias.shape
+        if bias.ndim != 4 or bias.shape[0] != 1:
+            raise KernelGeometryError(
+                f"fullrow_attention bias must be (1, 1|H, Lq, Lk), "
+                f"got shape {bias.shape}"
+            )
         bias_b = bias.shape[0]
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
-    assert supported(Lq, Lk, D, bias_b), (q.shape, k.shape)
+    if not supported(Lq, Lk, D, bias_b):
+        raise KernelGeometryError(
+            f"fullrow_attention refused q={q.shape} k={k.shape}: needs "
+            f"Lq/Lk 128-multiples <= {MAX_ROW}, D <= 128, bias batch 1, "
+            f"and a group=1 footprint inside the VMEM budget — callers "
+            f"fall back to flash_attention for these shapes"
+        )
     if kv_padding_mask is not None:
         kv_padding_mask = kv_padding_mask.astype(jnp.int32)[:, None, :]
     seed = jnp.reshape(jnp.asarray(dropout_seed, dtype=jnp.int32), (1,))
     return _fullrow(
         q, k, v, bias, kv_padding_mask,
+        # lint: host-sync-in-jit; dropout_rate is a static hyperparameter
         seed, sm_scale, float(dropout_rate), group,
     )
+
+
+# ---------------------------------------------------------------------------
+# representative audit shapes (unicore-tpu-lint --kernels; docs/lint.md)
+# ---------------------------------------------------------------------------
+
+@audit_case("fullrow-attention-fwd-bwd")
+def _audit_fullrow():
+    """Ulysses-leg geometry: full L=512 rows resident, shared bias,
+    dropout on; B=8 so ``_auto_group`` lands G=4 forward / G=2 backward
+    and the batch-group grid axis is real (size > 1) both ways."""
+    q = jnp.zeros((8, 2, 512, 64), jnp.float32)
+    kv = jnp.zeros((8, 2, 512, 64), jnp.float32)
+    bias = jnp.zeros((1, 2, 512, 512), jnp.float32)
+    mask = jnp.zeros((8, 512), jnp.int32)
+
+    def loss(q, kv, bias):
+        out = fullrow_attention(q, kv, kv, bias=bias, kv_padding_mask=mask,
+                                dropout_rate=0.1, dropout_seed=11)
+        return jnp.sum(out)
+
+    jax.grad(loss, argnums=(0, 1, 2))(q, kv, bias)

@@ -44,7 +44,15 @@ NEG_INF = -1e30  # big finite: -inf minus -inf would NaN the rescale path
 # interpret mode runs the kernels on any backend (CPU tests); dropout uses
 # TPU-only PRNG primitives and stays TPU-gated.  The switch is shared by all
 # ops/ kernels (ops/_pallas.py); these aliases keep the public API.
-from ._pallas import interpret_enabled, pallas_call as _pallas_call, set_interpret
+from ._pallas import (
+    KernelGeometryError,
+    audit_case,
+    check_vmem_budget,
+    interpret_enabled,
+    pallas_call as _pallas_call,
+    pick_block,
+    set_interpret,
+)
 
 
 def _cdiv(a, b):
@@ -52,12 +60,10 @@ def _cdiv(a, b):
 
 
 def _pick_block(length, preferred):
-    """Largest 128-multiple block <= preferred that divides length."""
-    b = min(preferred, length)
-    while b > 128 and length % b != 0:
-        b -= 128
-    assert length % b == 0, (length, preferred)
-    return b
+    """Largest 128-multiple block <= preferred that divides length (the
+    shared lane-step picker, ops/_pallas.py — raises KernelGeometryError
+    when nothing fits)."""
+    return pick_block(length, preferred)
 
 
 def _seed_block(seed_ref, b, h, iq, ik):
@@ -173,6 +179,24 @@ def _fwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q, block_k)
 
     has_bias = bias is not None
     has_mask = kv_mask is not None
+
+    # refuse here (rather than let Mosaic OOM on-device) when one grid
+    # step's resident blocks bust the shared budget — the --kernels
+    # auditor prices the identical model (analysis/kernel_geometry.py)
+    io_blocks = [
+        ((1, 1, BQ, D), q.dtype), ((1, 1, BK, D), k.dtype),
+        ((1, 1, BK, D), v.dtype),
+        ((1, 1, BQ, D), q.dtype), ((1, 1, BQ, 1), jnp.float32),
+    ]
+    if has_bias:
+        io_blocks.append(((1, 1, BQ, BK), bias.dtype))
+    if has_mask:
+        io_blocks.append(((1, 1, BK), kv_mask.dtype))
+    check_vmem_budget(
+        "flash_attention fwd", io_blocks,
+        [((BQ, 128), jnp.float32), ((BQ, 128), jnp.float32),
+         ((BQ, D), jnp.float32)],
+    )
 
     in_specs = [
         pl.BlockSpec((1, 1, BQ, D), lambda b, h, iq, ik, *_: (b, h, iq, 0)),
@@ -470,6 +494,33 @@ def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q,
     has_bias = bias is not None
     has_mask = kv_mask is not None
 
+    # same budget refusal as the forward, per backward kernel family
+    io_common = [
+        ((1, 1, BQ, D), q.dtype), ((1, 1, BK, D), k.dtype),
+        ((1, 1, BK, D), v.dtype),
+        ((1, 1, BQ, 1), jnp.float32), ((1, 1, BQ, 1), jnp.float32),
+        ((1, 1, BQ, D), do.dtype),
+    ]
+    if has_bias:
+        io_common.append(((1, 1, BQ, BK), bias.dtype))
+    if has_mask:
+        io_common.append(((1, 1, BK), kv_mask.dtype))
+    check_vmem_budget(
+        "flash_attention bwd dq", io_common + [((1, 1, BQ, D), q.dtype)],
+        [((BQ, D), jnp.float32)],
+    )
+    check_vmem_budget(
+        "flash_attention bwd dkv",
+        io_common + [((1, 1, BK, D), k.dtype), ((1, 1, BK, D), v.dtype)],
+        [((BK, D), jnp.float32), ((BK, D), jnp.float32)],
+    )
+    if has_bias:
+        check_vmem_budget(
+            "flash_attention bwd dbias",
+            io_common + [((1, 1, BQ, BK), jnp.float32)],
+            [((BQ, BK), jnp.float32)],
+        )
+
     di = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                  axis=-1, keepdims=True)
 
@@ -547,7 +598,10 @@ def _bwd(q, k, v, bias, kv_mask, seed, sm_scale, dropout_rate, block_q,
     dbias = None
     if has_bias:
         Bb, Hb = bias.shape[0], bias.shape[1]
-        assert Hb == H or Hb == 1
+        if Hb not in (1, H):
+            raise KernelGeometryError(
+                f"dbias kernel needs bias heads in (1, {H}), got {Hb}"
+            )
         R = B // Bb
         inputs, _ = _bwd_inputs(
             q, k, v, bias, kv_mask, lse, di, do, BQ, BK, kv_major=False
@@ -685,21 +739,27 @@ def flash_attention(
     if bias is not None:
         if bias.ndim == 3:
             bias = bias[None]
-        assert bias.ndim == 4
-        assert q.shape[0] % bias.shape[0] == 0, (
-            f"bias batch {bias.shape[0]} must divide batch {q.shape[0]}"
-        )
+        if bias.ndim != 4:
+            raise KernelGeometryError(
+                f"bias must be rank 3 or 4, got shape {bias.shape}"
+            )
+        if q.shape[0] % bias.shape[0] != 0:
+            raise KernelGeometryError(
+                f"bias batch {bias.shape[0]} must divide batch {q.shape[0]}"
+            )
         # 1 < Hb < H would silently read out-of-range head blocks (the
         # index map clamps on TPU) — reject here, not just in the dbias
         # backward branch
-        assert bias.shape[1] in (1, q.shape[1]), (
-            f"bias heads {bias.shape[1]} must be 1 or {q.shape[1]}"
-        )
+        if bias.shape[1] not in (1, q.shape[1]):
+            raise KernelGeometryError(
+                f"bias heads {bias.shape[1]} must be 1 or {q.shape[1]}"
+            )
     if kv_padding_mask is not None:
         kv_padding_mask = kv_padding_mask.astype(jnp.int32)[:, None, :]
     seed = jnp.reshape(jnp.asarray(dropout_seed, dtype=jnp.int32), (1,))
     return _flash(
         q, k, v, bias, kv_padding_mask, seed,
+        # lint: host-sync-in-jit; dropout_rate is a static hyperparameter
         sm_scale, float(dropout_rate), (block_q, block_k),
     )
 
@@ -720,3 +780,35 @@ def mha_reference(q, k, v, bias=None, kv_padding_mask=None, sm_scale=1.0):
     if kv_padding_mask is not None:
         p = jnp.where(kv_padding_mask[:, None, None, :].astype(bool), 0.0, p)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# representative audit shapes (unicore-tpu-lint --kernels; docs/lint.md)
+# ---------------------------------------------------------------------------
+
+@audit_case("flash-attention-fwd-bwd")
+def _audit_flash_fwd_bwd():
+    """BERT-ish training geometry at the default block plan (BQ=256,
+    BK=512 -> a 2x2 block grid): grouped bias (Bb=1, so the dbias kernel
+    gets a real R=2 reduction axis), padding mask, dropout on — all four
+    kernels (fwd, dq, dkv, dbias) capture with every spec branch live."""
+    q = jnp.zeros((2, 2, 512, 64), jnp.float32)
+    kv = jnp.zeros((2, 2, 1024, 64), jnp.float32)
+    bias = jnp.zeros((1, 2, 512, 1024), jnp.float32)
+    mask = jnp.zeros((2, 1024), jnp.int32)
+
+    def loss(q, kv, bias):
+        out = flash_attention(q, kv, kv, bias=bias, kv_padding_mask=mask,
+                              dropout_rate=0.1, dropout_seed=7)
+        return jnp.sum(out)
+
+    jax.grad(loss, argnums=(0, 1, 2))(q, kv, bias)
+
+
+@audit_case("flash-attention-bf16-nobias")
+def _audit_flash_bf16():
+    """bf16 inference geometry, no bias/mask: the lean spec list on the
+    16-row sublane grid."""
+    q = jnp.zeros((2, 4, 512, 64), jnp.bfloat16)
+    kv = jnp.zeros((2, 4, 512, 64), jnp.bfloat16)
+    flash_attention(q, kv, kv, sm_scale=0.125)

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._pallas import pallas_call as _pallas_call
+from ._pallas import audit_case, pallas_call as _pallas_call
 
 
 def _pick_rows(n, preferred=256):
@@ -296,3 +296,27 @@ def quant_layer_norm_pallas(x_q, x_scale, weight, bias, eps: float = 1e-5,
     y, _, _ = _ln_fwd(x2, weight, bias, eps, False, want_stats=False,
                       scale=scale, out_dtype=out_dtype)
     return y[:n].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# representative audit shapes (unicore-tpu-lint --kernels; docs/lint.md)
+# ---------------------------------------------------------------------------
+
+@audit_case("fused-norm-fwd-bwd")
+def _audit_fused_norm():
+    x = jnp.zeros((4, 128, 1024), jnp.float32)
+    w = jnp.ones((1024,), jnp.float32)
+    b = jnp.zeros((1024,), jnp.float32)
+
+    def loss(x, w, b):
+        return jnp.sum(fused_layer_norm(x, w, b))
+
+    jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+
+
+@audit_case("quant-layer-norm")
+def _audit_quant_layer_norm():
+    x_q = jnp.zeros((256, 1024), jnp.int8)
+    w = jnp.ones((1024,), jnp.float32)
+    b = jnp.zeros((1024,), jnp.float32)
+    quant_layer_norm_pallas(x_q, 0.05, w, b)

@@ -41,8 +41,16 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ._pallas import ModeGate, interpret_enabled, \
-    pallas_call as _pallas_call
+from ._pallas import (
+    KernelGeometryError,
+    ModeGate,
+    VMEM_BUDGET,
+    audit_case,
+    interpret_enabled,
+    pallas_call as _pallas_call,
+    pick_block_pow2,
+    vmem_footprint,
+)
 
 _gate = ModeGate("quant_matmul", "UNICORE_TPU_PALLAS_QUANT_MATMUL")
 
@@ -162,10 +170,46 @@ def _qmm_kernel(x_ref, w_ref, s_ref, b_ref, o_ref, *, activation, n_k):
 
 
 def _pick_block(n, limit):
-    b = min(limit, n)
-    while b > 1 and n % b != 0:
-        b //= 2
-    return b if n % b == 0 else 1
+    """Largest block <= limit dividing n by halving (the shared
+    power-of-two picker, ops/_pallas.py)."""
+    return pick_block_pow2(n, limit)
+
+
+def _plan_blocks(M, N, K, *, has_bias):
+    """Halving-discipline blocks shrunk until one grid step's resident
+    bytes fit the shared VMEM budget (ops/_pallas.py).
+
+    The ``--kernels`` auditor caught the unbudgeted picker handing Mosaic
+    a ~16 MiB step at serving lm-head shapes (M=512, K=N=4096, BK=4096
+    double-buffered): shrink K first (cheapest — more grid steps over the
+    same resident accumulator), then N, then M.
+    """
+    BM = pick_block_pow2(M, _MAX_BLOCK_M)
+    BN = pick_block_pow2(N, _MAX_BLOCK_N)
+    BK = pick_block_pow2(K, _MAX_BLOCK_K)
+
+    def fits(bm, bn, bk):
+        io = [((bm, bk), jnp.int8), ((bk, bn), jnp.int8),
+              ((1, bn), jnp.float32), ((bm, bn), jnp.float32)]
+        if has_bias:
+            io.append(((1, bn), jnp.float32))
+        return vmem_footprint(io) <= VMEM_BUDGET
+
+    while not fits(BM, BN, BK):
+        # halving an even divisor keeps divisibility; floors keep the
+        # last dims on the 128 lane grid and BM on the int8 sublane grid
+        if BK >= 256:
+            BK //= 2
+        elif BN >= 256:
+            BN //= 2
+        elif BM >= 64:
+            BM //= 2
+        else:
+            raise KernelGeometryError(
+                f"quant_matmul: no block plan for (M={M}, N={N}, K={K}) "
+                f"fits the {VMEM_BUDGET} B VMEM budget"
+            )
+    return BM, BN, BK
 
 
 def quant_matmul_pallas(x_q, w_q, scale, bias=None, activation: str = "",
@@ -176,9 +220,7 @@ def quant_matmul_pallas(x_q, w_q, scale, bias=None, activation: str = "",
     never touches HBM as a separate tensor."""
     M, K = x_q.shape
     N = w_q.shape[1]
-    BM = _pick_block(M, _MAX_BLOCK_M)
-    BN = _pick_block(N, _MAX_BLOCK_N)
-    BK = _pick_block(K, _MAX_BLOCK_K)
+    BM, BN, BK = _plan_blocks(M, N, K, has_bias=bias is not None)
     n_k = K // BK
     grid = (M // BM, N // BN, n_k)
 
@@ -257,3 +299,18 @@ def quant_matmul(x_q, w_q, scale, bias=None, activation: str = "",
                                      activation=activation,
                                      out_dtype=out_dtype)
     return out.reshape(lead + (N,))
+
+
+# ---------------------------------------------------------------------------
+# representative audit shapes (unicore-tpu-lint --kernels; docs/lint.md)
+# ---------------------------------------------------------------------------
+
+@audit_case("quant-matmul-serving")
+def _audit_quant_matmul():
+    """The serving lm-head geometry that exposed the unbudgeted block
+    plan (BK=4096 -> ~16 MiB per double-buffered grid step); the planner
+    must land inside the 12 MiB budget, epilogue branches populated."""
+    x = jnp.zeros((512, 4096), jnp.int8)
+    w = jnp.zeros((4096, 4096), jnp.int8)
+    quant_matmul(x, w, jnp.ones((4096,), jnp.float32),
+                 bias=jnp.zeros((4096,), jnp.float32), activation="gelu")

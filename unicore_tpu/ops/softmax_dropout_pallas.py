@@ -50,7 +50,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._pallas import interpret_enabled, pallas_call as _pallas_call
+from ._pallas import audit_case, interpret_enabled, pallas_call as _pallas_call
 
 # VMEM budget per (rows x L) fp32 block buffer (~256 KiB): the kernel holds
 # x, extras, probs and the random bits concurrently, so keep each modest.
@@ -404,6 +404,7 @@ def _dispatch_prep(name, input, plan_dtype, mask, bias, plans,
     R = 1
     for d in ishape[:-2]:
         R *= d
+    # lint: host-sync-in-jit; dropout_prob is a static hyperparameter
     rate = float(dropout_prob) if is_training else 0.0
     use_hw = not interpret_enabled()
     x3 = input.reshape(R, M, L)
@@ -463,3 +464,28 @@ def quant_softmax_dropout_pallas(
     out = _run(_fwd_kernel, ishape, x3, plans, (mask3, bias3), seed,
                out_dtype, rate, use_hw, scale3=scale3)
     return out.reshape(ishape)
+
+
+# ---------------------------------------------------------------------------
+# representative audit shapes (unicore-tpu-lint --kernels; docs/lint.md)
+# ---------------------------------------------------------------------------
+
+@audit_case("softmax-dropout-fwd-bwd")
+def _audit_softmax_dropout():
+    x = jnp.zeros((2, 4, 256, 512), jnp.float32)
+    bias = jnp.zeros((1, 4, 256, 512), jnp.float32)
+    mask = jnp.zeros((2, 1, 1, 512), jnp.float32)
+
+    def loss(x, bias):
+        out = softmax_dropout_pallas(x, 0.1, is_training=True, mask=mask,
+                                     bias=bias, seed=11)
+        return jnp.sum(out)
+
+    jax.grad(loss, argnums=(0, 1))(x, bias)
+
+
+@audit_case("quant-softmax-dropout")
+def _audit_quant_softmax_dropout():
+    x_q = jnp.zeros((2, 4, 256, 512), jnp.int8)
+    mask = jnp.zeros((2, 1, 1, 512), jnp.float32)
+    quant_softmax_dropout_pallas(x_q, 0.04, 0.0, mask=mask)

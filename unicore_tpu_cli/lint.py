@@ -46,6 +46,17 @@ def get_lint_parser() -> argparse.ArgumentParser:
         ),
     )
     parser.add_argument(
+        "--kernels",
+        action="store_true",
+        help=(
+            "run the Pallas kernel audit: import the kernel modules, run "
+            "their @audit_case representative shapes with pallas_call "
+            "intercepted, and enumerate every grid (docs/lint.md, "
+            "'Pallas kernel audit'); without this flag only the pure-AST "
+            "coverage rule runs"
+        ),
+    )
+    parser.add_argument(
         "--user-dir",
         default=None,
         help=(
@@ -76,6 +87,11 @@ def cli_main(argv: Optional[List[str]] = None) -> int:
         for rule in rules:
             print(f"{rule.name:20s} {rule.description}")
         return 0
+
+    if args.kernels:
+        from unicore_tpu.analysis import pallas_audit
+
+        pallas_audit.KERNEL_AUDIT_ENABLED = True
 
     try:
         violations = lint_paths(args.paths, rules=rules)
