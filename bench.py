@@ -24,6 +24,15 @@ seq 512) in bf16 on one chip.  ``BENCH_CONFIG`` selects the model family:
                             carrying the calibration drift bound
                             (BENCH_QUANT_LAYERS/EMBED size the model;
                             docs/serving.md "Quantized inference")
+    BENCH_CONFIG=decode     incremental decode (unicore_tpu/serve/decode.py):
+                            fp32-KV vs int8-KV DecodeEngine over the SAME
+                            transformer-LM weights at the SAME paced
+                            offered load — one tokens/s row per KV
+                            precision with per-token p50/p99, page
+                            occupancy, and the one-program-per-bucket +
+                            zero-recompile counters
+                            (BENCH_DECODE_QPS/SECONDS/LAYERS/EMBED;
+                            docs/serving.md "Incremental decode")
     BENCH_CONFIG=fleet      the serving FLEET (unicore_tpu/serve/fleet/):
                             N ∈ {1,2,3} real replica HTTP planes behind
                             the shedding router (lease-registered over a
@@ -800,6 +809,140 @@ def run_serve_quant_bench():
 
 
 # ---------------------------------------------------------------------------
+# incremental decode (BENCH_CONFIG=decode): fp32-KV vs int8-KV tokens/s
+# ---------------------------------------------------------------------------
+
+def run_decode_bench():
+    """Token throughput of the incremental-decode plane (docs/serving.md
+    "Incremental decode"): a fp32-KV and an int8-KV DecodeEngine over
+    the SAME transformer-LM weights, each driven by the same paced
+    request schedule (BENCH_DECODE_QPS), every request generating a
+    fixed token budget — so tokens/s + per-token p50/p99 compare KV
+    precisions, not admission luck.  Rows carry page occupancy and the
+    one-program-per-cache-bucket + zero-recompile counters.  CPU
+    fallback rows are labeled like every other config — liveness proof,
+    not a perf claim."""
+    import jax
+
+    from unicore_tpu.checkpoint.emergency import Deadline
+    from unicore_tpu.models.transformer_lm import TransformerLMModel
+    from unicore_tpu.serve import DecodeEngine, cache_bucket_edges
+
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
+    n_buckets = int(os.environ.get("BENCH_SERVE_BUCKETS", "2"))
+    duration = float(os.environ.get("BENCH_DECODE_SECONDS", "10"))
+    qps = float(os.environ.get("BENCH_DECODE_QPS", "8"))
+    layers = int(os.environ.get("BENCH_DECODE_LAYERS", "4"))
+    embed = int(os.environ.get("BENCH_DECODE_EMBED", "256"))
+    max_new = int(os.environ.get("BENCH_DECODE_MAX_NEW", "16"))
+    page_size = 32
+    vocab = 512
+
+    model = TransformerLMModel(
+        vocab_size=vocab,
+        padding_idx=1,
+        decoder_layers=layers,
+        decoder_embed_dim=embed,
+        decoder_ffn_embed_dim=4 * embed,
+        decoder_attention_heads=max(4, embed // 64),
+        dropout=0.0,
+        emb_dropout=0.0,
+        attention_dropout=0.0,
+        activation_dropout=0.0,
+        max_seq_len=seq_len,
+    )
+    rng = np.random.RandomState(0)
+    sample = {
+        "net_input": {
+            "src_tokens": rng.randint(
+                4, vocab, size=(batch_size, seq_len)
+            ).astype(np.int64)
+        }
+    }
+    variables = model.init_params(jax.random.PRNGKey(0), sample)
+    edges = cache_bucket_edges(seq_len, n_buckets, page_size=page_size)
+    # prompts leave max_new rows of cache headroom below the top bucket
+    lengths = [max(4, min(e, edges[-1] - max_new) - 1) for e in edges]
+    num_pages = max(
+        64, batch_size * 4 * ((edges[-1] + page_size - 1) // page_size)
+    )
+
+    last = None
+    for kv in ("fp32", "int8"):
+        engine = DecodeEngine(
+            model,
+            variables,
+            bucket_edges=edges,
+            decode_batch=batch_size,
+            page_size=page_size,
+            num_pages=num_pages,
+            pad_idx=1,
+            eos_idx=-1,  # fixed token budget: every request decodes max_new
+            vocab_size=vocab,
+            kv_dtype=kv,
+            max_new_tokens=max_new,
+            admission_capacity=max(64, batch_size * 8),
+            precision="int8-kv" if kv == "int8" else "",
+        )
+        programs = engine.warmup()
+        engine.start()
+        t0 = time.perf_counter()
+        t_end = t0 + duration
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            # identical offered schedule per arm: request i is DUE at
+            # t0 + i/qps regardless of how this arm is keeping up
+            target = t0 + i / qps
+            if now < target:
+                time.sleep(min(target - now, 0.01))
+                continue
+            engine.submit([5] * lengths[i % len(lengths)], 600.0)
+            i += 1
+        engine.drain(Deadline(300.0))
+        elapsed = time.perf_counter() - t0
+        engine.stop()
+
+        stats = engine.stats()
+        row = {
+            "metric": (
+                f"decode_lm_l{layers}e{embed}_seq{seq_len}_"
+                f"{kv}_kv_tokens_per_sec"
+            ),
+            "value": round(stats["tokens_generated"] / elapsed, 2),
+            "unit": "tok/s",
+            "vs_baseline": None,
+            "kv_dtype": kv,
+            "offered_qps": qps,
+            "offered": i,
+            "served": stats["served"],
+            "shed": sum(stats["shed"].values()),
+            "tokens_generated": stats["tokens_generated"],
+            "decode_steps": stats["decode_steps"],
+            "prefill_batches": stats["prefill_batches"],
+            "preempted": stats["preempted"],
+            "requeued": stats["requeued"],
+            "cache_pages": num_pages,
+            "cache_page_occupancy": stats["cache_page_occupancy"],
+            "max_new_tokens": max_new,
+            "bucket_programs": programs,
+            "recompiles_after_warmup": stats["recompiles_after_warmup"],
+            "decoder_layers": layers,
+            "embed_dim": embed,
+        }
+        for k in ("token_p50_ms", "token_p90_ms", "token_p99_ms"):
+            if k in stats:
+                row[k] = stats[k]
+        _append_partial(_label_row(row))
+        print(json.dumps(row), flush=True)
+        last = row
+    return last
+
+
+# ---------------------------------------------------------------------------
 # serving fleet (BENCH_CONFIG=fleet): N replicas behind the router
 # ---------------------------------------------------------------------------
 
@@ -1506,6 +1649,8 @@ def main():
                 runner = run_serve_bench
             elif c == "serve-quant":
                 runner = run_serve_quant_bench
+            elif c == "decode":
+                runner = run_decode_bench
             elif c == "fleet":
                 runner = run_fleet_bench
             elif c == "kernels":

@@ -1248,6 +1248,28 @@ def test_unbounded_serve_wait_only_in_serve_package(tmp_path):
     ) == []
 
 
+def test_unbounded_serve_wait_covers_decode_scheduler(tmp_path):
+    """serve/decode.py (the decode-step scheduler) is in scope: an
+    unbounded wait there stalls EVERY in-flight generation at once, so
+    the incremental-decode plane inherits the same bounded-wait
+    discipline (positive fixture: decode scope)."""
+    home = tmp_path / "serve"
+    home.mkdir()
+    path = home / "decode.py"
+    path.write_text(textwrap.dedent(
+        """
+        def step(ready_queue, pool_freed_event):
+            seq = ready_queue.get()
+            pool_freed_event.wait()
+            return seq
+        """
+    ))
+    vs = lint_paths(
+        [str(path)], rules=build_rules(["unbounded-serve-wait"])
+    )
+    assert rule_names(vs) == ["unbounded-serve-wait"] * 2
+
+
 def test_unbounded_serve_wait_covers_router_cli(tmp_path):
     """unicore_tpu_cli/router.py is the serving plane's front door: a
     timeout-less queue pop or event wait there is the exact slow-loris
@@ -1926,6 +1948,58 @@ def test_sharding_legality_negatives(tmp_path):
     assert _lint_dir(tmp_path, select=["sharding-legality"]) == []
     (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
     assert _lint_dir(tmp_path, select=["sharding-legality"]) == []
+
+
+def test_sharding_legality_kv_cache_axes_ok(tmp_path):
+    """The KV-cache pool PartitionSpec (pages replica-local, heads on the
+    declared model axis — serve/kv_cache.py's layout through
+    plan.kv_cache_axes) is legal: every named axis resolves to a declared
+    mesh axis."""
+    import textwrap
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    (tmp_path / "cache.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .mesh import MODEL_AXIS
+
+            # pool layout (num_pages, n_layers, heads, page_size, head_dim):
+            # pages replica-local, heads sharded on the model axis
+            KV_POOL_SPEC = P(None, None, MODEL_AXIS, None, None)
+
+            def shard_pools(mesh, k_pool, v_pool):
+                import jax
+
+                s = NamedSharding(mesh, KV_POOL_SPEC)
+                return jax.device_put(k_pool, s), jax.device_put(v_pool, s)
+            """
+        )
+    )
+    assert _lint_dir(tmp_path, select=["sharding-legality"]) == []
+
+
+def test_sharding_legality_kv_cache_undeclared_axis(tmp_path):
+    """A KV-cache spec inventing its own 'cache_page' axis (not declared
+    in the mesh constants) is flagged — cache arrays shard through the
+    SAME declared axes as everything else, or the plan's legality story
+    falls apart."""
+    import textwrap
+
+    (tmp_path / "mesh.py").write_text(_MESH_FIXTURE)
+    (tmp_path / "cache.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+            from .mesh import MODEL_AXIS
+
+            BAD_KV_POOL_SPEC = P("cache_page", None, MODEL_AXIS, None, None)
+            """
+        )
+    )
+    vs = _lint_dir(tmp_path, select=["sharding-legality"])
+    assert rule_names(vs) == ["sharding-legality"]
+    assert "'cache_page'" in vs[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -1181,3 +1181,132 @@ def test_cli_serve_quantized_int8_e2e(served_checkpoint, tmp_path):
     if drift.get("samples"):
         ref_absmax = max(float(quant.get("ref_logit_absmax", 0.0)), 1e-8)
         assert drift["max_abs"] < 2 * 0.05 * ref_absmax, (drift, quant)
+
+
+@pytest.fixture(scope="module")
+def decode_checkpoint(tmp_path_factory):
+    """Train 2 updates of transformer_lm_tiny (causal LM over the bert
+    example corpus) and hand back (checkpoint, data_dir) for the
+    incremental-decode serve plane."""
+    root = tmp_path_factory.mktemp("decode_e2e")
+    data = root / "data"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "bert", "make_example_data.py"),
+         str(data), "64", "40"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    argv = [
+        str(data),
+        "--task", "causal_lm", "--loss", "lm_cross_entropy",
+        "--arch", "transformer_lm_tiny",
+        "--optimizer", "adam", "--lr-scheduler", "polynomial_decay",
+        "--lr", "1e-3", "--warmup-updates", "1",
+        "--total-num-update", "2", "--max-update", "2",
+        "--max-epoch", "10", "--batch-size", "4", "--max-seq-len", "64",
+        "--log-interval", "1", "--log-format", "simple",
+        "--save-dir", str(root / "ckpt"), "--tmp-save-dir", str(root / "tmp"),
+        "--num-workers", "0", "--seed", "1", "--no-progress-bar",
+        "--disable-validation", "--required-batch-size-multiple", "1",
+        "--jax-compilation-cache-dir", _JAX_CACHE,
+    ]
+    proc = subprocess.run(
+        _runner_cmd("train", argv), capture_output=True, text=True,
+        timeout=CLI_TIMEOUT, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    ckpt = root / "ckpt" / "checkpoint_last.pt"
+    assert ckpt.exists()
+    return ckpt, data
+
+
+@pytest.mark.slow
+def test_cli_decode_serve_flood_generate_and_drain(
+    decode_checkpoint, tmp_path
+):
+    """Incremental-decode acceptance e2e: a causal-LM checkpoint
+    auto-selects the decode plane (prefill + paged KV cache +
+    step-level continuous batching), /v1/generate answers greedy
+    continuations, a request flood sheds with named reasons while
+    generation keeps making progress, steady state compiles NOTHING
+    after warm-up (one prefill + one decode program per cache bucket),
+    and SIGTERM drains in-flight generations to exit 0."""
+    ckpt, data = decode_checkpoint
+    deadline_ms = 10000.0
+    sp = ServeProc(tmp_path, [
+        "--path", str(ckpt), "--data", str(data),
+        "--port", "0", "--serve-batch-size", "2", "--serve-buckets", "2",
+        "--decode-batch-size", "2", "--cache-pages", "64",
+        "--max-new-tokens", "8",
+        "--admission-capacity", "16",
+        "--default-deadline-ms", str(deadline_ms),
+        "--drain-deadline", str(60 * _SCALE),
+        "--fault-inject", "request-flood:2000@0",
+        "--jax-compilation-cache-dir", _JAX_CACHE,
+    ])
+    try:
+        sp.wait_listening(120 * _SCALE)
+        assert "INCREMENTAL DECODE" in sp.log()
+        sp.wait_ready(240 * _SCALE)
+        # the flood window opens at readiness and saturates the decode
+        # batch; this real generation rides along (it may be shed — the
+        # point is the server keeps making token progress while shedding)
+        _post(
+            sp.base + "/v1/generate",
+            {"tokens": [5, 6, 7], "deadline_ms": 30000,
+             "max_new_tokens": 4},
+        )
+        deadline = time.monotonic() + 90 * _SCALE
+        stats = {}
+        while time.monotonic() < deadline:
+            _, stats = _get(sp.base + "/stats")
+            if stats.get("shed") and stats.get("tokens_generated"):
+                break
+            time.sleep(0.5)
+        assert stats.get("shed"), (
+            f"flood never shed: {stats}\n{sp.log()[-3000:]}"
+        )
+        assert set(stats["shed"]) & {
+            "queue-full", "deadline-unmeetable", "cache-oom",
+        }, stats
+        assert stats.get("tokens_generated", 0) > 0, stats
+        assert stats.get("mode") == "decode", stats
+        # flood window closes after 10s; a fresh generation must then
+        # land end to end
+        time.sleep(3)
+        deadline = time.monotonic() + 60 * _SCALE
+        code, body = None, {}
+        while time.monotonic() < deadline:
+            code, body = _post(
+                sp.base + "/v1/generate",
+                {"tokens": [5, 6, 7, 8], "deadline_ms": 60000,
+                 "max_new_tokens": 4},
+            )
+            if code == 200:
+                break
+            time.sleep(1.0)
+        assert code == 200 and body["status"] == "ok", (code, body)
+        # up to max_new cached tokens, plus the stopping eos if sampled
+        assert 1 <= len(body["output"]) <= 5, body
+        _, stats = _get(sp.base + "/stats")
+        assert stats.get("recompiles_after_warmup") == 0, stats
+        assert stats.get("served", 0) >= 1, stats
+        assert stats.get("token_p99_ms", 0) > 0, stats
+        with urllib.request.urlopen(sp.base + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            metrics = r.read().decode()
+        for want in (
+            "unicore_tpu_serve_tokens_generated_total",
+            "unicore_tpu_serve_cache_page_occupancy",
+            "unicore_tpu_serve_token_latency_seconds",
+        ):
+            assert want in metrics, f"missing metric {want}"
+    finally:
+        rc = sp.sigterm_and_wait(120 * _SCALE)
+    log = sp.log()
+    sys.stdout.write(log)  # CI smoke greps the serve log via pytest -s
+    assert rc == 0, f"drain exit {rc}:\n{log[-4000:]}"
+    assert "decode warm-up complete" in log
+    assert "DRAIN complete" in log
+    assert "recompile after warmup" not in log

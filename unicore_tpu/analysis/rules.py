@@ -1094,9 +1094,12 @@ class UnguardedKvWait(LintRule):
 # slices).  '# lint: serve-deadline-bounded' justifies a call whose bound
 # lives elsewhere (e.g. a socket with settimeout set at setup).
 #
-# Scope: the serve package (which includes serve/fleet/) AND the router
-# CLI (unicore_tpu_cli/router.py) — the router is the serving plane's
-# front door, and a timeout-less socket/queue wait there is the exact
+# Scope: the serve package (which includes serve/fleet/ and the
+# serve/decode.py step scheduler — a decode step that blocks unboundedly
+# stalls EVERY in-flight generation at once, so the incremental-decode
+# plane inherits the same discipline) AND the router CLI
+# (unicore_tpu_cli/router.py) — the router is the serving plane's front
+# door, and a timeout-less socket/queue wait there is the exact
 # slow-loris class PR 7 fixed in the replica transport.
 _SERVE_HOME = "serve"
 _ROUTER_CLI = ("unicore_tpu_cli", "router.py")
@@ -1135,12 +1138,14 @@ class UnboundedServeWait(LintRule):
     description = (
         "unbounded blocking wait (queue get/put, event/condition wait, "
         "join, socket accept without a timeout) inside unicore_tpu/serve/ "
-        "(incl. serve/fleet/) or unicore_tpu_cli/router.py: the serving "
-        "plane promises every wait is deadline-bounded — a slow client, "
-        "a wedged consumer, or a dark replica must time out with a named "
-        "reason, never hold a worker forever.  Pass a timeout, route "
-        "through utils/retry.bounded_wait, or justify a call bounded "
-        "elsewhere with '# lint: serve-deadline-bounded'"
+        "(incl. serve/fleet/ and the serve/decode.py decode-step "
+        "scheduler) or unicore_tpu_cli/router.py: the serving plane "
+        "promises every wait is deadline-bounded — a slow client, a "
+        "wedged consumer, a dark replica, or a stalled decode step must "
+        "time out with a named reason, never hold a worker (or every "
+        "in-flight generation) forever.  Pass a timeout, route through "
+        "utils/retry.bounded_wait, or justify a call bounded elsewhere "
+        "with '# lint: serve-deadline-bounded'"
     )
 
     def check(self, module: ModuleInfo) -> Iterator[Violation]:

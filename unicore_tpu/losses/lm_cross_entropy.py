@@ -1,0 +1,57 @@
+"""Shifted (next-token) cross-entropy for causal LMs.
+
+The model predicts position t+1 from positions <= t, so the loss pairs
+``logits[:, :-1]`` with ``target[:, 1:]`` and masks pad targets — the
+causal-LM counterpart of losses/cross_entropy.py, matching the
+tasks/causal_lm.py contract (target == input token stream).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from unicore_tpu.logging import metrics
+from . import register_loss
+from .unicore_loss import UnicoreLoss
+
+
+@register_loss("lm_cross_entropy")
+class LMCrossEntropyLoss(UnicoreLoss):
+    def __init__(self, task):
+        super().__init__(task)
+        self.padding_idx = task.dictionary.pad()
+
+    def forward(self, model, params, sample, rngs=None, train=True):
+        logits = model.apply(
+            params, **sample["net_input"], train=train, rngs=rngs
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        target = sample["target"][:, 1:]
+        valid = target != self.padding_idx
+        lprobs = jax.nn.log_softmax(
+            logits[:, :-1].astype(jnp.float32), axis=-1
+        )
+        safe_target = jnp.where(valid, target, 0)
+        nll = -jnp.take_along_axis(
+            lprobs, safe_target[..., None], axis=-1
+        )[..., 0]
+        loss = jnp.sum(jnp.where(valid, nll, 0.0))
+        sample_size = jnp.sum(valid).astype(jnp.float32)
+        logging_output = {
+            "loss": loss,
+            "sample_size": sample_size,
+            "bsz": jnp.asarray(target.shape[0], dtype=jnp.float32),
+        }
+        return loss, sample_size, logging_output
+
+    @staticmethod
+    def reduce_metrics(logging_outputs, split="train") -> None:
+        loss_sum = sum(log.get("loss", 0) for log in logging_outputs)
+        sample_size = sum(log.get("sample_size", 0) for log in logging_outputs)
+        metrics.log_scalar(
+            "loss", loss_sum / sample_size / jnp.log(2), sample_size, round=3
+        )
+
+    @staticmethod
+    def logging_outputs_can_be_summed(is_train) -> bool:
+        return True

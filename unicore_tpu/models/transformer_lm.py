@@ -1,0 +1,202 @@
+"""Decoder-only causal LM
+(the serving-plane counterpart of models/bert.py: same registry contract,
+built on modules/transformer_decoder.py so ISSUE's incremental-decode path
+has a first-class trainable model behind it).
+
+TPU notes:
+- learned positional embeddings + the decoder's bucketed rel-pos bias,
+  exactly the Bert recipe transposed to the causal stack;
+- the LM head is the tied ``embed_tokens.attend`` projection + bias — no
+  intermediate dense, so the decode step's program stays one embed, one
+  decoder stack, one matmul;
+- :meth:`prefill` and :meth:`decode_step` are the serving surface
+  (docs/serving.md, "Incremental decode"): prefill runs the normal causal
+  forward once and returns the per-layer K/V stacks; decode_step embeds ONE
+  token per sequence at its current position and runs the cache-reading
+  step (ops/decode_attention).  Both are flax methods on the same
+  submodules as ``__call__`` — identical parameters, so incremental decode
+  is step-for-step parity-checked against the full forward
+  (tests/test_decode.py).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from unicore_tpu import utils
+from unicore_tpu.models import register_model, register_model_architecture
+from unicore_tpu.models.unicore_model import (
+    BaseUnicoreModel,
+    strip_diagnostic_collections,
+)
+from unicore_tpu.modules import TransformerDecoder, bert_init
+
+
+@register_model("transformer_lm")
+class TransformerLMModel(BaseUnicoreModel):
+    vocab_size: int = 30522
+    padding_idx: int = 1
+    decoder_layers: int = 6
+    decoder_embed_dim: int = 768
+    decoder_ffn_embed_dim: int = 3072
+    decoder_attention_heads: int = 12
+    dropout: float = 0.1
+    emb_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    activation_dropout: float = 0.0
+    max_seq_len: int = 512
+    activation_fn: str = "gelu"
+    post_ln: bool = False
+    # quantized serving ('int8'): decode caches quantize per kv_cache.py;
+    # the flag rides here so serve-side clones carry it like BertModel's
+    quantize: str = ""
+
+    @classmethod
+    def add_args(cls, parser):
+        parser.add_argument("--decoder-layers", type=int,
+                            help="num decoder layers")
+        parser.add_argument("--decoder-embed-dim", type=int,
+                            help="decoder embedding dimension")
+        parser.add_argument("--decoder-ffn-embed-dim", type=int,
+                            help="decoder FFN embedding dimension")
+        parser.add_argument("--decoder-attention-heads", type=int,
+                            help="num decoder attention heads")
+        parser.add_argument("--activation-fn", type=str,
+                            help="activation function to use")
+        parser.add_argument("--emb-dropout", type=float, metavar="D",
+                            help="dropout probability for embeddings")
+        parser.add_argument("--dropout", type=float, metavar="D",
+                            help="dropout probability")
+        parser.add_argument("--attention-dropout", type=float, metavar="D",
+                            help="dropout probability for attention weights")
+        parser.add_argument("--activation-dropout", type=float, metavar="D",
+                            help="dropout probability after activation in FFN")
+        parser.add_argument("--max-seq-len", type=int,
+                            help="number of positional embeddings to learn")
+        parser.add_argument("--post-ln", type=utils.str_to_bool,
+                            help="use post layernorm or pre layernorm")
+
+    @classmethod
+    def build_model(cls, args, task):
+        lm_base_architecture(args)
+        return cls(
+            vocab_size=len(task.dictionary),
+            padding_idx=task.dictionary.pad(),
+            decoder_layers=args.decoder_layers,
+            decoder_embed_dim=args.decoder_embed_dim,
+            decoder_ffn_embed_dim=args.decoder_ffn_embed_dim,
+            decoder_attention_heads=args.decoder_attention_heads,
+            dropout=args.dropout,
+            emb_dropout=args.emb_dropout,
+            attention_dropout=args.attention_dropout,
+            activation_dropout=args.activation_dropout,
+            max_seq_len=args.max_seq_len,
+            activation_fn=args.activation_fn,
+            post_ln=args.post_ln,
+        )
+
+    def setup(self):
+        self.embed_tokens = nn.Embed(
+            self.vocab_size,
+            self.decoder_embed_dim,
+            embedding_init=bert_init,
+            name="embed_tokens",
+            param_dtype=jnp.float32,
+        )
+        self.embed_positions = nn.Embed(
+            self.max_seq_len,
+            self.decoder_embed_dim,
+            embedding_init=bert_init,
+            name="embed_positions",
+            param_dtype=jnp.float32,
+        )
+        self.decoder = TransformerDecoder(
+            decoder_layers=self.decoder_layers,
+            embed_dim=self.decoder_embed_dim,
+            ffn_embed_dim=self.decoder_ffn_embed_dim,
+            attention_heads=self.decoder_attention_heads,
+            emb_dropout=self.emb_dropout,
+            dropout=self.dropout,
+            attention_dropout=self.attention_dropout,
+            activation_dropout=self.activation_dropout,
+            max_seq_len=self.max_seq_len,
+            activation_fn=self.activation_fn,
+            rel_pos=True,
+            rel_pos_bins=32,
+            max_rel_pos=128,
+            post_ln=self.post_ln,
+            auto_regressive=True,
+            name="decoder",
+        )
+        self.out_bias = self.param(
+            "out_bias", nn.initializers.zeros, (self.vocab_size,), jnp.float32
+        )
+
+    def _logits(self, x):
+        return self.embed_tokens.attend(x) + self.out_bias
+
+    def _embed(self, src_tokens):
+        seq_len = src_tokens.shape[1]
+        x = self.embed_tokens(src_tokens)
+        pos = self.embed_positions(jnp.arange(seq_len, dtype=jnp.int32))
+        return x + pos[None, :, :]
+
+    def __call__(self, src_tokens, train: bool = False, **kwargs):
+        padding_mask = (src_tokens == self.padding_idx).astype(jnp.float32)
+        x = self._embed(src_tokens)
+        x = self.decoder(x, padding_mask=padding_mask, train=train)
+        return self._logits(x)
+
+    # -- serving surface ---------------------------------------------------
+
+    def prefill(self, src_tokens):
+        """Causal forward over the (right-padded) prompt bucket, seeding the
+        cache: returns ``(logits, (k, v))`` with per-layer K/V stacks
+        (n_layers, B, H, Lp, D).  No padding mask — pads sit on the right,
+        so the causal mask already keeps them out of every real row; pad
+        rows' K/V are junk the decode step position-masks away."""
+        x = self._embed(src_tokens)
+        x, kv = self.decoder(x, train=False, return_kv=True)
+        return self._logits(x), kv
+
+    def decode_step(self, tokens_t, caches, positions, kv_scales=None):
+        """One decode step: ``tokens_t`` (B,) int32 the current token ids,
+        ``positions`` (B,) their rows.  Returns ``(logits, (k_rows,
+        v_rows))`` — logits (B, V) for sampling the NEXT token, rows
+        (n_layers, B, H, D) for the caller's page scatter."""
+        x = (self.embed_tokens(tokens_t)
+             + self.embed_positions(positions.astype(jnp.int32)))[:, None, :]
+        x, rows = self.decoder.decode_step(
+            x, caches, positions, kv_scales=kv_scales
+        )
+        return self._logits(x[:, 0]), rows
+
+    def init_params(self, rng, sample):
+        src_tokens = jnp.asarray(sample["net_input"]["src_tokens"])
+        return strip_diagnostic_collections(self.init(
+            {"params": rng, "dropout": rng}, src_tokens, train=False
+        ))
+
+
+@register_model_architecture("transformer_lm", "transformer_lm")
+def lm_base_architecture(args):
+    args.decoder_layers = getattr(args, "decoder_layers", 6)
+    args.decoder_embed_dim = getattr(args, "decoder_embed_dim", 768)
+    args.decoder_ffn_embed_dim = getattr(args, "decoder_ffn_embed_dim", 3072)
+    args.decoder_attention_heads = getattr(args, "decoder_attention_heads", 12)
+    args.dropout = getattr(args, "dropout", 0.1)
+    args.emb_dropout = getattr(args, "emb_dropout", 0.1)
+    args.attention_dropout = getattr(args, "attention_dropout", 0.1)
+    args.activation_dropout = getattr(args, "activation_dropout", 0.0)
+    args.max_seq_len = getattr(args, "max_seq_len", 512)
+    args.activation_fn = getattr(args, "activation_fn", "gelu")
+    args.post_ln = getattr(args, "post_ln", False)
+
+
+@register_model_architecture("transformer_lm", "transformer_lm_tiny")
+def transformer_lm_tiny_architecture(args):
+    args.decoder_layers = getattr(args, "decoder_layers", 2)
+    args.decoder_embed_dim = getattr(args, "decoder_embed_dim", 64)
+    args.decoder_ffn_embed_dim = getattr(args, "decoder_ffn_embed_dim", 128)
+    args.decoder_attention_heads = getattr(args, "decoder_attention_heads", 4)
+    args.max_seq_len = getattr(args, "max_seq_len", 128)
+    lm_base_architecture(args)

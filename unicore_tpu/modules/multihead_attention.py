@@ -472,7 +472,28 @@ class SelfMultiheadAttention(nn.Module):
         attn_bias: Optional[jnp.ndarray] = None,
         return_attn: bool = False,
         train: bool = False,
+        cache_kv=None,
+        cache_positions: Optional[jnp.ndarray] = None,
+        kv_scales=None,
+        return_kv: bool = False,
     ):
+        """Standard self-attention over ``query`` (B, L, E) — plus the
+        incremental-decode surface (docs/serving.md, "Incremental
+        decode"), same projections/params either way:
+
+        * ``return_kv``: also return the split-heads K/V
+          ((B, H, L, D) each) so a PREFILL forward can seed the cache;
+        * ``cache_kv=(k_cache, v_cache)`` ((B, H, Lc, D) each, fp or
+          int8) with ``cache_positions`` (B,) int32: DECODE — ``query``
+          is one token (B, 1, E); its K/V row is written at each
+          sequence's position (quantized against ``kv_scales``
+          = (k_scale, v_scale), each (H, D), when the cache is int8),
+          then the single query row attends the cache through
+          ``ops/decode_attention``.  ``attn_bias`` is the (B, H, Lc)
+          bias ROW at the current positions.  Returns
+          ``(out, (k_row, v_row))`` — the new rows (B, H, D) in the
+          cache dtype, for the caller's page scatter.
+        """
         bsz, tgt_len, embed_dim = query.shape
         assert embed_dim == self.embed_dim
         head_dim = embed_dim // self.num_heads
@@ -493,7 +514,16 @@ class SelfMultiheadAttention(nn.Module):
         k = _split_heads(k, self.num_heads)
         v = _split_heads(v, self.num_heads)
 
-        if self.seq_inside:
+        new_rows = None
+        if cache_kv is not None:
+            assert tgt_len == 1, (
+                f"decode takes one token per step, got {tgt_len}"
+            )
+            o, new_rows = self._decode(
+                q, k, v, cache_kv, cache_positions, kv_scales, attn_bias
+            )
+            attn_weights = attn_probs = None
+        elif self.seq_inside:
             o = self._ring_in_shard(
                 q, k, v, key_padding_mask, attn_bias, return_attn, train
             )
@@ -517,10 +547,51 @@ class SelfMultiheadAttention(nn.Module):
             param_dtype=jnp.float32,
             quantize=self.quantize,
         )(o)
+        if cache_kv is not None:
+            return o, new_rows
+        if return_kv:
+            return o, (k, v)
         if not return_attn:
             return o
         else:
             return o, attn_weights, attn_probs
+
+    def _decode(self, q, k, v, cache_kv, cache_positions, kv_scales,
+                attn_bias):
+        """One incremental step: write this token's K/V row into the
+        gathered cache view (so the token attends itself), then read the
+        cache through the single-query kernel.  The UPDATED caches are
+        ephemeral — only the new rows return; the serving plane's page
+        pool is the source of truth (serve/kv_cache.py)."""
+        from unicore_tpu.ops.decode_attention import decode_attention
+
+        k_cache, v_cache = cache_kv
+        k_row, v_row = k, v  # (B, H, 1, D)
+        k_scale = v_scale = None
+        if k_cache.dtype == jnp.int8:
+            from unicore_tpu.ops.quant_matmul import (
+                INT8_QMAX, quantize_to_dtype,
+            )
+
+            assert kv_scales is not None, "int8 KV cache needs kv_scales"
+            k_scale, v_scale = kv_scales  # (H, D) each
+            k_row = quantize_to_dtype(
+                k_row, k_scale[None, :, None, :], INT8_QMAX, jnp.int8
+            )
+            v_row = quantize_to_dtype(
+                v_row, v_scale[None, :, None, :], INT8_QMAX, jnp.int8
+            )
+        positions = cache_positions.astype(jnp.int32)
+        write = jax.vmap(
+            lambda c, t, p: jax.lax.dynamic_update_slice(c, t, (0, p, 0))
+        )
+        k_cache = write(k_cache, k_row, positions)
+        v_cache = write(v_cache, v_row, positions)
+        o = decode_attention(
+            q[:, :, 0, :], k_cache, v_cache, positions,
+            bias=attn_bias, k_scale=k_scale, v_scale=v_scale,
+        )
+        return o[:, :, None, :], (k_row[:, :, 0, :], v_row[:, :, 0, :])
 
     def _ring_in_shard(self, q, k, v, key_padding_mask, attn_bias,
                        return_attn, train):

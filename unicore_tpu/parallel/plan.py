@@ -72,6 +72,18 @@ AXIS_TIERS: Dict[str, str] = {
 #: cross-pod gradient-combine modes (parallel/hierarchy.py)
 XPOD_COMBINE_CHOICES = ("sum", "adasum")
 
+#: KV-cache pool axis roles (serve/kv_cache.py; docs/serving.md,
+#: "Incremental decode").  The page pools lay out as
+#: ``(num_pages, n_layers, heads, page_size, head_dim)``: the page
+#: dimension stays replica-local (each serve replica owns its own pool —
+#: the fleet shards by request, not by page), and the HEAD dimension is
+#: the one model-parallel cache axis, riding the same mesh axis the
+#: attention heads already shard over.  Declared here so the
+#: ``sharding-legality`` analysis accepts cache PartitionSpecs exactly
+#: like any other axis use — the cache learns the plan's axes, it never
+#: invents its own.
+CACHE_HEAD_AXIS = MODEL_AXIS
+
 
 class PlanLegalityError(ValueError):
     """A plan violated a named composition rule.  Raised at plan
@@ -157,6 +169,23 @@ class ParallelPlan:
     def fixed_product(self) -> int:
         """Product of every axis size except ``data`` (the -1 absorber)."""
         return self.pods * self.model * self.seq * self.pipe * self.expert
+
+    def kv_cache_axes(self, num_heads: int) -> Tuple[Optional[str], ...]:
+        """Mesh axes of the paged KV pools, one entry per pool dimension
+        ``(num_pages, n_layers, heads, page_size, head_dim)`` — pages
+        replica-local, heads over :data:`CACHE_HEAD_AXIS` when the plan
+        runs model parallelism.  This is the legality funnel for the
+        cache: an indivisible head count is rejected HERE, by rule name,
+        before any pool exists."""
+        if self.model > 1 and num_heads % self.model != 0:
+            raise PlanLegalityError(
+                "cache-heads-indivisible",
+                f"KV-cache pools shard {num_heads} heads over "
+                f"{CACHE_HEAD_AXIS}={self.model}; the head count must "
+                "divide the model-parallel size",
+            )
+        head_axis = CACHE_HEAD_AXIS if self.model > 1 else None
+        return (None, None, head_axis, None, None)
 
     # -- legality -----------------------------------------------------------
 

@@ -16,7 +16,13 @@ entry point.
 """
 
 from unicore_tpu.serve.admission import AdmissionQueue
+from unicore_tpu.serve.decode import DecodeEngine
 from unicore_tpu.serve.engine import ServeEngine, build_infer_fn
+from unicore_tpu.serve.kv_cache import (
+    PagedKVCache,
+    cache_bucket_edges,
+    calibrate_kv_scales,
+)
 from unicore_tpu.serve.reload import (
     CheckpointWatcher,
     HotReloader,
@@ -27,10 +33,14 @@ from unicore_tpu.serve.request import ServeRequest, ServeResponse
 __all__ = [
     "AdmissionQueue",
     "CheckpointWatcher",
+    "DecodeEngine",
     "HotReloader",
+    "PagedKVCache",
     "ReloadRunner",
     "ServeEngine",
     "ServeRequest",
     "ServeResponse",
     "build_infer_fn",
+    "cache_bucket_edges",
+    "calibrate_kv_scales",
 ]

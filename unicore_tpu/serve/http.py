@@ -12,6 +12,10 @@ in the engine/admission layer; this module only maps outcomes onto HTTP:
 * ``POST /v1/infer`` → ``{"tokens": [...], "deadline_ms": N, "id": "..."}``
   → 200 ok / 429 shed (named reason) / 503 not-ready-or-draining /
   504 expired / 408 slow client;
+* ``POST /v1/generate`` → same envelope plus optional
+  ``"max_new_tokens": N`` → autoregressive generation on a decode engine
+  (serve/decode.py); 404 on engines that don't generate, and cache-page
+  exhaustion sheds 429 ``cache-oom``;
 * ``POST /v1/reload`` (fleet members only) → run this replica's OWN
   verify→probe→swap on its served checkpoint NOW, answering the named
   outcome — what the router's rolling reload orchestrates one replica
@@ -52,6 +56,8 @@ _SHED_CODES = {
     rq.SHED_TOO_LONG: 400,
     rq.SHED_DRAINING: 503,
     rq.SHED_NOT_READY: 503,
+    # decode plane: no KV-cache pages for the prompt — capacity, so 429
+    rq.SHED_CACHE_OOM: 429,
 }
 
 
@@ -223,10 +229,19 @@ class ServeHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/reload":
             self._handle_reload()
             return
-        if self.path != "/v1/infer":
+        if self.path not in ("/v1/infer", "/v1/generate"):
             self._send_json(404, {"error": f"unknown path {self.path}"})
             return
         server = self.server
+        generate = self.path == "/v1/generate"
+        if generate and not getattr(server.engine, "supports_generate",
+                                    False):
+            self._send_json(
+                404,
+                {"error": "this engine does not generate (serve a "
+                          "decoder-only checkpoint, e.g. transformer_lm)"},
+            )
+            return
         # chaos 'replica-stall': wedge the inference plane while the
         # lease publisher keeps beating — the zombie replica.  The wait
         # is sliced so a closed stall window releases the worker.
@@ -273,6 +288,19 @@ class ServeHandler(BaseHTTPRequestHandler):
                 raise ValueError(
                     f"'deadline_ms' must be a number, got {raw_deadline!r}"
                 ) from None
+            max_new = payload.get("max_new_tokens")
+            if max_new is not None:
+                try:
+                    max_new = int(max_new)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "'max_new_tokens' must be an integer, got "
+                        f"{max_new!r}"
+                    ) from None
+                if max_new <= 0:
+                    raise ValueError(
+                        "'max_new_tokens' must be positive"
+                    )
         except SlowClientError as err:
             # the body was never fully consumed: leftover bytes on the
             # keep-alive stream would be parsed as the NEXT request line,
@@ -290,9 +318,15 @@ class ServeHandler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as err:
             self._send_json(400, {"status": "error", "reason": str(err)})
             return
-        req = server.engine.submit(
-            tokens, deadline_ms / 1000.0, payload.get("id")
-        )
+        if generate:
+            req = server.engine.submit(
+                tokens, deadline_ms / 1000.0, payload.get("id"),
+                max_new_tokens=max_new,
+            )
+        else:
+            req = server.engine.submit(
+                tokens, deadline_ms / 1000.0, payload.get("id")
+            )
         try:
             # the engine resolves every admitted request by its deadline
             # (expired-at-*), so the grace only covers scheduling slop
@@ -374,6 +408,6 @@ def bind_server(host: str, port: int, engine, **kw) -> ServeHTTPServer:
     logger.info(
         f"SERVE listening on http://{server.server_address[0]}:"
         f"{server.server_address[1]} "
-        "(/healthz /readyz /stats /metrics /v1/infer)"
+        "(/healthz /readyz /stats /metrics /v1/infer /v1/generate)"
     )
     return server

@@ -1,0 +1,226 @@
+"""Paged KV cache: block-allocated pages + per-sequence page tables.
+
+The decode fleet's memory is bounded by TOKENS IN FLIGHT, not by
+``max_seq_len x batch``: K/V live in two fixed pools of shape
+``(num_pages, n_layers, heads, page_size, head_dim)`` and each sequence
+owns just the pages its tokens have reached, handed out from a host-side
+free list.  The compiled decode step never sees the pool's raggedness —
+the engine gathers each batch's pages into a contiguous
+``(n_layers, B, H, L, D)`` view (L = the batch's cache-length bucket),
+runs the step, and scatters the new K/V rows back.  The gathered view is
+ephemeral; the pool is the single source of truth.
+
+Sentinel page index == ``num_pages``: gathers clamp it to the last page
+(junk the position mask kills), scatters use ``mode='drop'`` so sentinel
+writes vanish.  That makes short sequences in a big bucket safe with no
+per-sequence branching.
+
+int8 KV variant: pools hold int8, quantized on write against STATIC
+per-(layer, head, channel) scales (:func:`calibrate_kv_scales`, max-abs
+over a calibration prefill / 127 — PR-12's ``quantize_to_dtype``
+contract), dequantized inside the attention read
+(ops/decode_attention.py) so the fp32 cache is never materialized.
+
+Sharding: pools place through the ParallelPlan
+(:meth:`~unicore_tpu.parallel.plan.ParallelPlan.kv_cache_axes` — pages
+replica-local, heads on ``CACHE_HEAD_AXIS``); see docs/serving.md,
+"Incremental decode".
+"""
+
+import logging
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+#: pages are 32 rows so every cache-length bucket is automatically legal
+#: for the decode-attention kernel's strictest sublane tile (32 for int8,
+#: 16 bf16, 8 fp32 — ops/_pallas.SUBLANE_BY_ITEMSIZE)
+DEFAULT_PAGE_SIZE = 32
+
+
+def cache_bucket_edges(
+    max_seq_len: int,
+    num_buckets: int,
+    page_size: int = DEFAULT_PAGE_SIZE,
+) -> List[int]:
+    """Evenly spaced cache-length buckets covering ``max_seq_len``, every
+    edge a page multiple (hence a 32-multiple at the default page size:
+    decode programs compile once per edge and the kernel's tiling is
+    always legal)."""
+    if max_seq_len <= 0:
+        raise ValueError(f"max_seq_len must be positive, got {max_seq_len}")
+    top = math.ceil(max_seq_len / page_size)
+    num_buckets = max(1, min(num_buckets, top))
+    step = math.ceil(top / num_buckets)
+    edges = sorted({min(step * i, top) * page_size
+                    for i in range(1, num_buckets + 1)} | {top * page_size})
+    return edges
+
+
+def bucket_for(length: int, edges) -> int:
+    """Smallest edge >= length (lengths above the top edge are the
+    caller's admission problem)."""
+    for e in edges:
+        if length <= e:
+            return e
+    raise ValueError(f"length {length} exceeds top cache bucket {edges[-1]}")
+
+
+# ---------------------------------------------------------------------------
+# pure pool ops — traced into the compiled prefill/decode programs
+# ---------------------------------------------------------------------------
+
+def gather_pages(pool: jnp.ndarray, page_table: jnp.ndarray) -> jnp.ndarray:
+    """Contiguous per-batch cache view: ``page_table`` (B, P) int32 page
+    ids (sentinel entries clamp to junk rows the position mask kills) ->
+    ``(n_layers, B, H, P*page_size, D)``."""
+    view = pool[page_table]  # (B, P, nl, H, ps, D)
+    b, p, nl, h, ps, d = view.shape
+    return view.transpose(2, 0, 3, 1, 4, 5).reshape(nl, b, h, p * ps, d)
+
+
+def scatter_rows(
+    pool: jnp.ndarray,
+    pages: jnp.ndarray,
+    slots: jnp.ndarray,
+    rows: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write one decode step's new K or V row per sequence:
+    ``pages``/``slots`` (B,) int32 (page id + row within the page — the
+    engine precomputes ``pos // ps`` / ``pos % ps``), ``rows``
+    (n_layers, B, H, D).  Sentinel pages drop."""
+    vals = rows.transpose(1, 0, 2, 3)  # (B, nl, H, D)
+    return pool.at[pages, :, :, slots, :].set(vals, mode="drop")
+
+
+def scatter_prefill(
+    pool: jnp.ndarray,
+    pages: jnp.ndarray,
+    slots: jnp.ndarray,
+    kv: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write a whole prompt's K or V: ``pages``/``slots`` (B, Lp) int32
+    per-token page + slot, ``kv`` (n_layers, B, H, Lp, D) from the
+    prefill forward.  Pad rows carry the sentinel page and drop."""
+    vals = kv.transpose(1, 3, 0, 2, 4)  # (B, Lp, nl, H, D)
+    return pool.at[pages, :, :, slots, :].set(vals, mode="drop")
+
+
+def calibrate_kv_scales(
+    k: jnp.ndarray, v: jnp.ndarray, eps: float = 1e-6
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Static per-(layer, head, channel) dequant scales from a
+    calibration prefill's stacks (n_layers, B, H, L, D):
+    ``max-abs / INT8_QMAX``, floored so dead channels stay finite."""
+    from unicore_tpu.ops.quant_matmul import INT8_QMAX
+
+    k_scale = jnp.maximum(jnp.max(jnp.abs(k), axis=(1, 3)), eps) / INT8_QMAX
+    v_scale = jnp.maximum(jnp.max(jnp.abs(v), axis=(1, 3)), eps) / INT8_QMAX
+    return k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)
+
+
+def quantize_kv(kv: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Quantize a prefill K or V stack (n_layers, B, H, L, D) against
+    (n_layers, H, D) scales -> int8 (decode rows quantize in-layer,
+    modules/multihead_attention.py)."""
+    from unicore_tpu.ops.quant_matmul import INT8_QMAX, quantize_to_dtype
+
+    return quantize_to_dtype(
+        kv, scale[:, None, :, None, :], INT8_QMAX, jnp.int8
+    )
+
+
+# ---------------------------------------------------------------------------
+# the pool + host-side page accounting
+# ---------------------------------------------------------------------------
+
+class PagedKVCache:
+    """Two device pools + a host free list.
+
+    Page ownership is host state (the scheduler's single thread), the
+    pools are device arrays threaded through the compiled step (donated,
+    so the update is in-place).  ``sentinel`` (== num_pages) marks unused
+    page-table entries.
+    """
+
+    def __init__(
+        self,
+        num_pages: int,
+        n_layers: int,
+        n_heads: int,
+        head_dim: int,
+        page_size: int = DEFAULT_PAGE_SIZE,
+        dtype=jnp.float32,
+        kv_scales: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+    ):
+        if dtype == jnp.int8 and kv_scales is None:
+            raise ValueError("int8 KV pools need calibrated kv_scales")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.sentinel = self.num_pages
+        self.dtype = dtype
+        self.kv_scales = kv_scales
+        shape = (self.num_pages, n_layers, n_heads, self.page_size, head_dim)
+        self.k_pool = jnp.zeros(shape, dtype)
+        self.v_pool = jnp.zeros(shape, dtype)
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+
+    # -- accounting --------------------------------------------------------
+
+    def pages_for(self, length: int) -> int:
+        return math.ceil(length / self.page_size)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages off the free list, or None when the pool can't cover
+        them (the scheduler sheds or preempts — never a partial grant)."""
+        if n > len(self._free):
+            return None
+        got = self._free[-n:][::-1]
+        del self._free[-n:]
+        return got
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if not (0 <= p < self.num_pages):
+                raise ValueError(f"freeing bogus page {p}")
+        self._free.extend(pages)
+        if len(self._free) > self.num_pages:
+            raise RuntimeError("double-free: free list exceeds pool")
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of pages in use — the /stats + Prometheus gauge."""
+        return 1.0 - len(self._free) / max(1, self.num_pages)
+
+    def table(self, pages: List[int], bucket: int) -> np.ndarray:
+        """Fixed-width page table for a sequence in ``bucket``: its pages
+        then sentinel padding (host numpy; batches stack these)."""
+        width = bucket // self.page_size
+        t = np.full((width,), self.sentinel, np.int32)
+        t[: len(pages)] = pages
+        return t
+
+    # -- sharding ----------------------------------------------------------
+
+    def shard_by_plan(self, plan, mesh=None) -> None:
+        """Place the pools through the ParallelPlan's cache axes (no-op
+        without a mesh — single-device serving)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from unicore_tpu.parallel.mesh import get_global_mesh
+
+        mesh = mesh if mesh is not None else get_global_mesh()
+        if plan is None or mesh is None:
+            return
+        axes = plan.kv_cache_axes(self.k_pool.shape[2])
+        sharding = NamedSharding(mesh, PartitionSpec(*axes))
+        self.k_pool = jax.device_put(self.k_pool, sharding)
+        self.v_pool = jax.device_put(self.v_pool, sharding)

@@ -27,6 +27,10 @@ SHED_DEADLINE_UNMEETABLE = "deadline-unmeetable"
 SHED_DRAINING = "draining"
 SHED_NOT_READY = "not-ready"
 SHED_TOO_LONG = "too-long"
+#: admission-time page exhaustion on the decode plane: the paged KV cache
+#: cannot cover even the prompt (serve/decode.py sheds at the door rather
+#: than preempting every in-flight generation)
+SHED_CACHE_OOM = "cache-oom"
 
 # -- expiry stages (request admitted, deadline ran out) ---------------------
 EXPIRED_AT_ADMISSION = "expired-at-admission"

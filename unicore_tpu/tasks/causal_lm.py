@@ -1,0 +1,80 @@
+"""Causal-LM task: the BERT data pipeline minus the masking stage.
+
+Same shards, tokenizer, padding/bucketing discipline as tasks/bert.py —
+``target`` is simply the input token stream and the ``lm_cross_entropy``
+loss shifts it by one (next-token prediction).  Exists so the
+incremental-decode serving path (models/transformer_lm.py,
+docs/serving.md "Incremental decode") has a trainable decoder-only
+checkpoint behind it, end-to-end from ``examples/bert/make_example_data.py``
+text.
+"""
+
+import logging
+import os
+
+from unicore_tpu.data import (
+    BertTokenizeDataset,
+    Dictionary,
+    EpochShuffleDataset,
+    NestedDictionaryDataset,
+    RightPadDataset,
+)
+from unicore_tpu.tasks import register_task
+from unicore_tpu.tasks.bert import open_text_dataset
+from unicore_tpu.tasks.unicore_task import UnicoreTask
+
+logger = logging.getLogger(__name__)
+
+
+@register_task("causal_lm")
+class CausalLMTask(UnicoreTask):
+    """Next-token-prediction over the same corpora the BERT task reads."""
+
+    @staticmethod
+    def add_args(parser):
+        parser.add_argument(
+            "data",
+            help="colon separated path to data directories list, "
+                 "iterated upon during epochs in round-robin manner",
+        )
+        parser.add_argument(
+            "--seq-pad-multiple", default=8, type=int,
+            help="pad batch sequence lengths to this multiple; 128 aligns "
+                 "batches with the flash-attention kernel's block size",
+        )
+
+    def __init__(self, args, dictionary):
+        super().__init__(args)
+        self.dictionary = dictionary
+        self.seed = args.seed
+
+    @classmethod
+    def setup_task(cls, args, **kwargs):
+        dictionary = Dictionary.load(os.path.join(args.data, "dict.txt"))
+        logger.info(f"dictionary: {len(dictionary)} types")
+        return cls(args, dictionary)
+
+    def _padded(self, dataset):
+        return RightPadDataset(
+            dataset,
+            pad_idx=self.dictionary.pad(),
+            pad_to_multiple=self.args.seq_pad_multiple,
+            pad_to_buckets=self.length_bucket_edges(),
+        )
+
+    def load_dataset(self, split, combine=False, **kwargs):
+        a = self.args
+        tokens = BertTokenizeDataset(
+            open_text_dataset(os.path.join(a.data, split)),
+            os.path.join(a.data, "dict.txt"),
+            max_seq_len=a.max_seq_len,
+        )
+        batches = NestedDictionaryDataset(
+            {
+                "net_input": {"src_tokens": self._padded(tokens)},
+                "target": self._padded(tokens),
+            }
+        )
+        if split == "train":
+            batches = EpochShuffleDataset(batches, len(batches), self.seed)
+        self.datasets[split] = batches

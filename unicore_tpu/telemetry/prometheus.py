@@ -168,6 +168,45 @@ def render_engine(engine) -> str:
                     float(stats[pct]) / 1000.0,
                     labels={"quantile": "0." + pct[1:-3]},
                     help="request latency percentiles over a sliding window")
+    if stats.get("mode") == "decode":
+        # decode plane (serve/decode.py): generation throughput + paged
+        # KV-cache pressure + the continuous-batching churn counters
+        reg.set("unicore_tpu_serve_tokens_generated_total",
+                stats.get("tokens_generated", 0),
+                help="tokens sampled across all generations",
+                type="counter")
+        reg.set("unicore_tpu_serve_tokens_per_second",
+                stats.get("tokens_per_s", 0.0),
+                help="generation throughput since readiness")
+        reg.set("unicore_tpu_serve_cache_page_occupancy",
+                stats.get("cache_page_occupancy", 0.0),
+                help="fraction of KV-cache pages in use")
+        reg.set("unicore_tpu_serve_cache_pages_free",
+                stats.get("cache_pages_free", 0),
+                help="KV-cache pages on the free list")
+        reg.set("unicore_tpu_serve_active_sequences",
+                stats.get("active_sequences", 0),
+                help="generations currently holding cache pages")
+        reg.set("unicore_tpu_serve_preempted_total",
+                stats.get("preempted", 0),
+                help="sequences preempted by cache-page exhaustion",
+                type="counter")
+        reg.set("unicore_tpu_serve_requeued_total",
+                stats.get("requeued", 0),
+                help="step-level scheduler re-entries (continuous "
+                     "batching churn)", type="counter")
+        reg.set("unicore_tpu_serve_decode_steps_total",
+                stats.get("decode_steps", 0),
+                help="decode step batches dispatched", type="counter")
+        reg.set("unicore_tpu_serve_prefill_batches_total",
+                stats.get("prefill_batches", 0),
+                help="prefill batches dispatched", type="counter")
+        for pct in ("token_p50_ms", "token_p90_ms", "token_p99_ms"):
+            if pct in stats:
+                reg.set("unicore_tpu_serve_token_latency_seconds",
+                        float(stats[pct]) / 1000.0,
+                        labels={"quantile": "0." + pct.split("_")[1].lstrip("p")},
+                        help="per-token decode-step latency percentiles")
     return reg.render() + _registry.render()
 
 

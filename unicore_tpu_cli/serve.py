@@ -22,7 +22,11 @@ rollback); ``--fault-inject`` arms the serving chaos kinds;
 ``--serve-quantize {int8,fp8}`` inserts a calibration pass before warm-up
 and serves the quantized per-bucket programs (dequant fused into the
 consuming ops; reload re-verifies scales and rolls back
-``rejected:calibration`` on mismatch).  ``--advertise`` +
+``rejected:calibration`` on mismatch).  Decoder-only checkpoints (e.g.
+``transformer_lm``) serve INCREMENTAL DECODE by default
+(``--serve-decode``): a paged KV cache, a prefill/decode program split,
+and step-level continuous batching behind ``POST /v1/generate``
+(``--decode-kv int8`` halves cache bytes per token in flight).  ``--advertise`` +
 ``--fleet-kv`` joins a serving fleet: the replica self-registers
 through a serve-namespaced heartbeat lease (address, readiness,
 snapshot digest, /stats admission estimate), flips its lease ready
@@ -109,6 +113,11 @@ def load_serving_model(args):
         if getattr(task, "dictionary", None) is not None
         else 0
     )
+    eos_idx = (
+        task.dictionary.eos()
+        if getattr(task, "dictionary", None) is not None
+        else 2
+    )
     vocab_size = (
         len(task.dictionary)
         if getattr(task, "dictionary", None) is not None
@@ -121,7 +130,59 @@ def load_serving_model(args):
         f"serving model from {args.path} (step {step}, task "
         f"{type(task).__name__}, max_seq_len {max_seq_len})"
     )
-    return model, variables, pad_idx, max_seq_len, vocab_size
+    return model, variables, pad_idx, max_seq_len, vocab_size, eos_idx
+
+
+def decode_serving_requested(args, model) -> bool:
+    """``--serve-decode`` resolution: 'auto' turns the decode plane on
+    exactly when the model exposes the serving surface (prefill +
+    decode_step); 'on' demands it (exit-76 territory otherwise)."""
+    mode = getattr(args, "serve_decode", "auto")
+    has_surface = hasattr(model, "prefill") and hasattr(model, "decode_step")
+    if mode == "off":
+        return False
+    if mode == "on" and not has_surface:
+        raise ValueError(
+            f"--serve-decode on: {type(model).__name__} has no "
+            "prefill/decode_step surface; serve a decoder-only checkpoint "
+            "(e.g. transformer_lm) or drop the flag"
+        )
+    return has_surface
+
+
+def build_decode_engine(args, model, variables, pad_idx, max_seq_len,
+                        vocab_size, eos_idx):
+    """The incremental-decode engine (docs/serving.md 'Incremental
+    decode'): cache-length buckets in page multiples, a paged KV pool
+    sized by ``--cache-pages``, step-level continuous batching."""
+    from unicore_tpu.serve import DecodeEngine, cache_bucket_edges
+
+    if args.serve_quantize != "off":
+        raise ValueError(
+            "--serve-quantize is the encoder-path weight quantization; "
+            "the decode plane quantizes its KV cache via --decode-kv int8 "
+            "(use --serve-decode off to serve this checkpoint through the "
+            "encoder path)"
+        )
+    edges = cache_bucket_edges(
+        max_seq_len, args.serve_buckets, page_size=args.cache_page_size
+    )
+    return DecodeEngine(
+        model, variables,
+        bucket_edges=edges,
+        decode_batch=args.decode_batch_size,
+        prefill_batch=args.serve_batch_size,
+        pad_idx=pad_idx,
+        eos_idx=eos_idx,
+        vocab_size=vocab_size,
+        num_pages=args.cache_pages,
+        page_size=args.cache_page_size,
+        kv_dtype=args.decode_kv,
+        max_new_tokens=args.max_new_tokens,
+        admission_capacity=args.admission_capacity,
+        precision="int8-kv" if args.decode_kv == "int8" else "",
+        decode_sample_every=args.decode_sample_every,
+    )
 
 
 def serve_buckets(args, max_seq_len):
@@ -465,27 +526,42 @@ def main(args) -> int:
 
     # 1. verified model load (+ calibration when quantizing) -----------------
     try:
-        model, variables, pad_idx, max_seq_len, vocab_size = \
+        model, variables, pad_idx, max_seq_len, vocab_size, eos_idx = \
             load_serving_model(args)
-        edges = serve_buckets(args, max_seq_len)
-        quant_extras = {}
         preparer = preparer_abort = None
-        serve_model, serve_variables = model, variables
-        if args.serve_quantize != "off":
-            serve_model, serve_variables, quant_extras = \
-                setup_quantized_serving(
-                    args, model, variables, pad_idx, max_seq_len,
-                    vocab_size, edges,
-                )
-            preparer = quant_extras.pop("preparer")
-            preparer_abort = quant_extras.pop("preparer_abort")
-            engine_cell = quant_extras.pop("engine_cell")
-        engine = build_engine(
-            args, serve_model, serve_variables, pad_idx, max_seq_len,
-            edges=edges, **quant_extras,
-        )
-        if preparer is not None:
-            engine_cell["engine"] = engine
+        if decode_serving_requested(args, model):
+            # decode plane: paged KV cache + prefill/decode split +
+            # step-level continuous batching (POST /v1/generate)
+            engine = build_decode_engine(
+                args, model, variables, pad_idx, max_seq_len,
+                vocab_size, eos_idx,
+            )
+            logger.info(
+                f"serving INCREMENTAL DECODE: cache buckets "
+                f"{list(engine.bucket_edges)}, "
+                f"{args.cache_pages} pages x {args.cache_page_size} rows, "
+                f"kv {args.decode_kv}, decode batch "
+                f"{args.decode_batch_size}, max_new {args.max_new_tokens}"
+            )
+        else:
+            edges = serve_buckets(args, max_seq_len)
+            quant_extras = {}
+            serve_model, serve_variables = model, variables
+            if args.serve_quantize != "off":
+                serve_model, serve_variables, quant_extras = \
+                    setup_quantized_serving(
+                        args, model, variables, pad_idx, max_seq_len,
+                        vocab_size, edges,
+                    )
+                preparer = quant_extras.pop("preparer")
+                preparer_abort = quant_extras.pop("preparer_abort")
+                engine_cell = quant_extras.pop("engine_cell")
+            engine = build_engine(
+                args, serve_model, serve_variables, pad_idx, max_seq_len,
+                edges=edges, **quant_extras,
+            )
+            if preparer is not None:
+                engine_cell["engine"] = engine
     except Exception as err:
         logger.error(
             f"FATAL: model load failed ({type(err).__name__}: {err}) — "
